@@ -1,0 +1,33 @@
+"""HTTP server example — parity with reference examples/http-server/main.go."""
+import sys
+sys.path.insert(0, "../..")
+
+from gofr_tpu import new_app
+from gofr_tpu.http.errors import EntityNotFound
+
+
+def hello(ctx):
+    name = ctx.param("name") or "World"
+    return {"message": f"Hello {name}!"}
+
+
+def get_user(ctx):
+    uid = ctx.path_param("id")
+    if uid != "1":
+        raise EntityNotFound("id", uid)
+    return {"id": 1, "name": "ada"}
+
+
+def create_user(ctx):
+    data = ctx.bind()
+    ctx.logger.info("creating user", user=data)
+    return data
+
+
+app = new_app()
+app.get("/hello", hello)
+app.get("/user/{id}", get_user)
+app.post("/user", create_user)
+
+if __name__ == "__main__":
+    app.run()

@@ -1,0 +1,22 @@
+"""gofr_tpu — a TPU-native microservice framework.
+
+Brand-new framework with the capability surface of GoFr (the Go microservice
+framework surveyed in SURVEY.md): one ``App`` wires HTTP, gRPC, CLI, cron,
+websocket, and pub/sub entry points around a dependency-injection ``Container``
+that owns datasources and observability — plus the TPU as a first-class
+container datasource: handlers call ``ctx.tpu.predict(...)`` which dispatches
+through an in-process JAX/XLA executor holding AOT-compiled models resident in
+TPU HBM, with dynamic batching in front and mesh-sharded (ICI) execution for
+multi-chip slices.
+
+Reference capability map: /root/reference/pkg/gofr (gofr.go:34-52 ``App``,
+context.go:12-27 ``Context``). This package is an original TPU-first design,
+not a port.
+"""
+
+from gofr_tpu.app import App, new_app, new_cmd
+from gofr_tpu.context import Context
+from gofr_tpu.version import FRAMEWORK_VERSION
+
+__all__ = ["App", "Context", "new_app", "new_cmd", "FRAMEWORK_VERSION"]
+__version__ = FRAMEWORK_VERSION

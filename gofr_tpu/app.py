@@ -1,0 +1,366 @@
+"""App — the single object wiring every entry point of the framework.
+
+Capability parity with ``pkg/gofr/gofr.go`` (``App`` 34-52, ``New`` 62-96,
+``NewCMD`` 99-109, ``Run`` 112-190: metrics + HTTP + gRPC servers and
+subscriber loops joined under one lifecycle; route verbs 222-244;
+``Subscribe`` 392-400; ``AddCronJob`` 422-430; ``Migrate`` 270-275;
+``AddRESTHandlers`` 402-413; WebSocket DSL websocket.go:18-35;
+``SubCommand`` 266-268; default ports default.go:3-7).
+
+Original design: one asyncio event loop owns all servers (the reference uses
+one goroutine per server joined by a WaitGroup); handlers may be async or
+plain ``def`` (thread-pooled). The TPU executor's dynamic batcher lives on
+the same loop, so request coalescing is allocation-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from gofr_tpu.config import Config, EnvConfig
+from gofr_tpu.container import Container
+from gofr_tpu.context import Context
+from gofr_tpu.cron import Crontab
+from gofr_tpu.handler import (
+    Handler,
+    catch_all_handler,
+    favicon_handler,
+    live_handler,
+    make_health_handler,
+    wrap_handler,
+)
+from gofr_tpu.http.middleware import (
+    api_key_auth_middleware,
+    basic_auth_middleware,
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    oauth_middleware,
+    tracing_middleware,
+)
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.router import Router
+from gofr_tpu.http.server import HTTPServer
+from gofr_tpu.logging import new_file_logger
+from gofr_tpu.metrics.exposition import render_prometheus
+from gofr_tpu.metrics.manager import system_metrics_refresh
+
+DEFAULT_HTTP_PORT = 8000   # reference: default.go:3-7
+DEFAULT_GRPC_PORT = 9000
+DEFAULT_METRICS_PORT = 2121
+
+
+class App:
+    def __init__(self, config: Optional[Config] = None,
+                 container: Optional[Container] = None):
+        self.config: Config = config if config is not None else EnvConfig()
+        self.container: Container = (
+            container if container is not None
+            else Container.create(self.config)
+        )
+        self.logger = self.container.logger
+        self.router = Router()
+        self.crontab = Crontab(self.container)
+        self._subscriptions: Dict[str, Handler] = {}
+        self._websocket_routes: Dict[str, Handler] = {}
+        self._grpc_services: List[tuple] = []
+        self._cli_commands: List[Any] = []
+        self._request_timeout = self.config.get_float("REQUEST_TIMEOUT", 0.0)
+        self.http_port = self.config.get_int("HTTP_PORT", DEFAULT_HTTP_PORT)
+        self.grpc_port = self.config.get_int("GRPC_PORT", DEFAULT_GRPC_PORT)
+        self.metrics_port = self.config.get_int("METRICS_PORT", DEFAULT_METRICS_PORT)
+        self._http_server: Optional[HTTPServer] = None
+        self._metrics_server: Optional[HTTPServer] = None
+        self._grpc_server = None
+        self._tasks: List[asyncio.Task] = []
+        self._shutdown: Optional[asyncio.Event] = None  # created in start()
+        self._install_default_middleware()
+
+    # -- middleware chain (httpServer.go:24-30 order) -----------------------
+    def _install_default_middleware(self) -> None:
+        self.router.use_middleware(
+            tracing_middleware(self.container.tracer),
+            logging_middleware(self.logger),
+            cors_middleware(self.config, self.router),
+            metrics_middleware(self.container.metrics),
+        )
+
+    def use_middleware(self, *middlewares) -> None:
+        self.router.use_middleware(*middlewares)
+
+    # -- auth sugar (reference: EnableBasicAuth etc.) ----------------------
+    def enable_basic_auth(self, users: Dict[str, str]) -> None:
+        self.router.use_middleware(basic_auth_middleware(users=users))
+
+    def enable_basic_auth_with_validator(self, validate: Callable) -> None:
+        self.router.use_middleware(
+            basic_auth_middleware(validate=validate, container=self.container))
+
+    def enable_api_key_auth(self, *keys: str) -> None:
+        self.router.use_middleware(api_key_auth_middleware(keys=keys))
+
+    def enable_api_key_auth_with_validator(self, validate: Callable) -> None:
+        self.router.use_middleware(
+            api_key_auth_middleware(validate=validate, container=self.container))
+
+    def enable_oauth(self, jwks_url: str, refresh_interval: float = 300.0) -> None:
+        self.router.use_middleware(
+            oauth_middleware(jwks_url=jwks_url, refresh_interval=refresh_interval))
+
+    # -- route verbs (gofr.go:222-244) --------------------------------------
+    def add_route(self, method: str, pattern: str, handler: Handler) -> None:
+        wire = wrap_handler(handler, self.container,
+                            timeout=self._request_timeout or None)
+        self.router.add(method, pattern, wire)
+
+    def get(self, pattern: str, handler: Handler) -> None:
+        self.add_route("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Handler) -> None:
+        self.add_route("POST", pattern, handler)
+
+    def put(self, pattern: str, handler: Handler) -> None:
+        self.add_route("PUT", pattern, handler)
+
+    def patch(self, pattern: str, handler: Handler) -> None:
+        self.add_route("PATCH", pattern, handler)
+
+    def delete(self, pattern: str, handler: Handler) -> None:
+        self.add_route("DELETE", pattern, handler)
+
+    def options(self, pattern: str, handler: Handler) -> None:
+        self.add_route("OPTIONS", pattern, handler)
+
+    def head(self, pattern: str, handler: Handler) -> None:
+        self.add_route("HEAD", pattern, handler)
+
+    def add_static_files(self, url_prefix: str, directory: str) -> None:
+        self.router.add_static_files(url_prefix, directory)
+
+    # -- CRUD scaffolding (gofr.go:402-413) --------------------------------
+    def add_rest_handlers(self, entity_class: type) -> None:
+        from gofr_tpu.crud import register_crud_routes
+        register_crud_routes(self, entity_class)
+
+    # -- pub/sub (gofr.go:392-400) ------------------------------------------
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        if self.container.pubsub is None:
+            self.logger.error(
+                "subscribe(%r) ignored: no PUBSUB_BACKEND configured", topic)
+            return
+        self._subscriptions[topic] = handler
+
+    # -- websocket DSL (websocket.go:18-35) ---------------------------------
+    def websocket(self, pattern: str, handler: Handler) -> None:
+        from gofr_tpu.websocket.upgrade import make_ws_route
+        self.router.add("GET", pattern, make_ws_route(handler, self.container))
+
+    # -- cron (gofr.go:422-430) ---------------------------------------------
+    def add_cron_job(self, spec: str, name: str, func: Handler) -> None:
+        self.crontab.add_job(spec, name, func)
+
+    # -- migrations (gofr.go:270-275) ---------------------------------------
+    def migrate(self, migrations: Dict[int, Any]) -> None:
+        from gofr_tpu.migration import run_migrations
+        try:
+            run_migrations(self.container, migrations)
+        except Exception as exc:
+            self.logger.error("migration run failed: %r", exc)
+            raise
+
+    # -- gRPC (gofr.go:55-59 RegisterService) -------------------------------
+    def register_grpc_service(self, register_fn: Callable, servicer: Any) -> None:
+        """``register_fn`` is the protoc-generated ``add_*Servicer_to_server``;
+        ``servicer`` the implementation."""
+        self._grpc_services.append((register_fn, servicer))
+
+    def register_grpc_unary(self, service: str, method: str,
+                            handler: Handler) -> None:
+        """Register a dynamic JSON unary RPC without protoc (original to this
+        framework; see gofr_tpu/grpcx)."""
+        self._grpc_services.append((("dynamic", service, method), handler))
+
+    # -- CLI mode (gofr.go:266-268, cmd.go) ---------------------------------
+    def sub_command(self, pattern: str, handler: Handler,
+                    description: str = "", help_text: str = "") -> None:
+        from gofr_tpu.cli.command import CLICommand
+        self._cli_commands.append(
+            CLICommand(pattern, handler, description, help_text))
+
+    # -- TPU model registration (north star) --------------------------------
+    def add_model(self, name: str, model, **kwargs) -> None:
+        """Register a servable model with the container's TPU executor."""
+        if self.container.tpu is None:
+            from gofr_tpu.tpu import new_executor
+            self.container.tpu = new_executor(self.config, self.logger,
+                                              self.container.metrics)
+        self.container.tpu.register(name, model, **kwargs)
+
+    # -- dispatch -----------------------------------------------------------
+    async def _dispatch(self, request: Request):
+        handler, params, other_method = self.router.lookup(
+            request.method, request.path)
+        if handler is None:
+            if other_method:
+                from gofr_tpu.http.errors import MethodNotAllowed
+                from gofr_tpu.http.responder import Responder
+                wire = self.router.wrap(
+                    lambda req: _error_response(MethodNotAllowed()))
+                return await wire(request)
+            wire = self.router.wrap(catch_all_handler)
+            return await wire(request)
+        request.path_params = params
+        return await self.router.wrap(handler)(request)
+
+    def _register_default_routes(self) -> None:
+        """/.well-known + favicon + openapi (gofr.go:133-146)."""
+        routes = set(self.router.registered_routes)
+        if "GET /.well-known/health" not in routes:
+            self.router.add("GET", "/.well-known/health",
+                            make_health_handler(self.container))
+        if "GET /.well-known/alive" not in routes:
+            self.router.add("GET", "/.well-known/alive", live_handler)
+        if "GET /favicon.ico" not in routes:
+            self.router.add("GET", "/favicon.ico", favicon_handler)
+        openapi_path = os.path.join("static", "openapi.json")
+        if os.path.isfile(openapi_path):
+            from gofr_tpu.openapi import make_openapi_handlers
+            spec_handler, ui_handler = make_openapi_handlers(openapi_path)
+            self.router.add("GET", "/.well-known/openapi.json", spec_handler)
+            self.router.add("GET", "/.well-known/swagger", ui_handler)
+
+    async def _metrics_dispatch(self, request: Request):
+        if request.path in ("/metrics", "/"):
+            system_metrics_refresh(self.container.metrics,
+                                   self.container.app_name,
+                                   self.container.app_version)
+            body = render_prometheus(self.container.metrics).encode()
+            return 200, {"Content-Type": "text/plain; version=0.0.4"}, body
+        return 404, {}, b"not found"
+
+    # -- subscriber loops (subscriber.go:27-57) -----------------------------
+    async def _subscriber_loop(self, topic: str, handler: Handler) -> None:
+        pubsub = self.container.pubsub
+        while True:
+            try:
+                message = await pubsub.subscribe(topic)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                self.logger.error("subscriber %s receive error: %r", topic, exc)
+                await asyncio.sleep(1.0)
+                continue
+            if message is None:
+                return
+            ctx = Context(message, self.container)
+            with self.container.tracer.start_span(f"subscribe:{topic}"):
+                try:
+                    result = handler(ctx)
+                    if asyncio.iscoroutine(result):
+                        await result
+                    message.commit()  # commit-on-success (subscriber.go:51-53)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    self.logger.error(
+                        "subscriber %s handler panicked: %r", topic, exc)
+
+    # -- lifecycle (gofr.go:112-190) ----------------------------------------
+    async def start(self) -> None:
+        self._shutdown = asyncio.Event()
+        self._register_default_routes()
+
+        self._metrics_server = HTTPServer(
+            self._metrics_dispatch, self.metrics_port, logger=self.logger)
+        await self._metrics_server.start()
+
+        self._http_server = HTTPServer(
+            self._dispatch, self.http_port, logger=self.logger)
+        await self._http_server.start()
+
+        if self._grpc_services:
+            from gofr_tpu.grpcx.server import GRPCServer
+            self._grpc_server = GRPCServer(
+                self.container, self.grpc_port, logger=self.logger)
+            for spec, servicer in self._grpc_services:
+                self._grpc_server.register(spec, servicer)
+            await self._grpc_server.start()
+
+        for topic, handler in self._subscriptions.items():
+            self._tasks.append(
+                asyncio.ensure_future(self._subscriber_loop(topic, handler)))
+
+        self.crontab.start()
+        self.logger.info("app %s started (http=:%d metrics=:%d%s)",
+                         self.container.app_name, self.http_port,
+                         self.metrics_port,
+                         f" grpc=:{self.grpc_port}" if self._grpc_server else "")
+
+    async def stop(self) -> None:
+        self.crontab.stop()
+        for task in self._tasks:
+            task.cancel()
+        self._tasks.clear()
+        if self._http_server is not None:
+            await self._http_server.shutdown()
+        if self._metrics_server is not None:
+            await self._metrics_server.shutdown()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop()
+        await self.container.close()
+        if self._shutdown is not None:
+            self._shutdown.set()
+
+    async def serve(self) -> None:
+        await self.start()
+        loop = asyncio.get_running_loop()
+        stop_requested = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop_requested.set)
+            except (NotImplementedError, RuntimeError):
+                pass
+        await stop_requested.wait()
+        self.logger.info("shutdown signal received")
+        await self.stop()
+
+    def run(self) -> None:
+        """Blocking entry point (gofr.go:112). CLI apps dispatch to the
+        command router instead (cmd.go:32-72)."""
+        if self._cli_commands:
+            from gofr_tpu.cli.runner import run_cli
+            run_cli(self)
+            return
+        try:
+            asyncio.run(self.serve())
+        except KeyboardInterrupt:
+            pass
+
+    # test helper: bound ports after start()
+    @property
+    def bound_http_port(self) -> int:
+        return self._http_server.bound_port if self._http_server else self.http_port
+
+
+async def _error_response(error):
+    from gofr_tpu.http.responder import Responder
+    return Responder().respond(None, error, "GET")
+
+
+def new_app(config_dir: str = "./configs") -> App:
+    """Server app factory (reference: gofr.go:62-96 ``New``)."""
+    return App(config=EnvConfig(config_dir))
+
+
+def new_cmd(config_dir: str = "./configs") -> App:
+    """CLI app factory: logs to file so stdout stays clean for command output
+    (reference: gofr.go:99-109 ``NewCMD``)."""
+    config = EnvConfig(config_dir)
+    log_file = config.get_or_default("CMD_LOGS_FILE", "")
+    container = Container.create(
+        config, logger=new_file_logger(log_file) if log_file else None)
+    app = App(config=config, container=container)
+    return app

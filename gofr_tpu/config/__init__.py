@@ -1,0 +1,3 @@
+from gofr_tpu.config.config import Config, EnvConfig, MapConfig, load_env_file
+
+__all__ = ["Config", "EnvConfig", "MapConfig", "load_env_file"]

@@ -1,0 +1,188 @@
+"""Container — dependency-injection root owning all shared state.
+
+Capability parity with ``pkg/gofr/container/container.go`` (Container struct
+27-46; ``Create`` composition root 63-146: remote logger, metrics manager +
+framework metrics, Redis, SQL, pub/sub backend switch from env, File;
+framework metric catalog 158-190) and ``container/health.go`` (aggregated
+deep health 8-66).
+
+TPU addition (north star): the container owns a ``tpu`` executor datasource —
+models resident in device HBM, AOT-compiled XLA executables, per-device
+health — created when ``TPU_ENABLED`` is truthy, with a CPU-backed executor
+as the test double (the "miniredis of XLA", SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from gofr_tpu.config import Config, MapConfig
+from gofr_tpu.logging import Level, Logger, new_logger, new_silent_logger
+from gofr_tpu.metrics import Manager, new_manager
+from gofr_tpu.trace import Tracer, new_tracer
+from gofr_tpu.version import FRAMEWORK_VERSION
+
+
+class Container:
+    def __init__(self, config: Optional[Config] = None,
+                 logger: Optional[Logger] = None):
+        self.config: Config = config if config is not None else MapConfig()
+        self.app_name = self.config.get_or_default("APP_NAME", "gofr-tpu-app")
+        self.app_version = self.config.get_or_default("APP_VERSION", "dev")
+        self.logger: Logger = logger if logger is not None else new_logger()
+        self.metrics: Manager = new_manager(self.logger)
+        self.tracer: Tracer = Tracer()
+        self.services: Dict[str, Any] = {}
+
+        # datasources (all optional; wired by create())
+        self.sql = None
+        self.redis = None
+        self.pubsub = None
+        self.mongo = None
+        self.cassandra = None
+        self.clickhouse = None
+        self.file = None
+        self.tpu = None
+
+        self._start_time = time.time()
+
+    # -- composition root (container.go:63-146) -----------------------------
+    @classmethod
+    def create(cls, config: Config, logger: Optional[Logger] = None) -> "Container":
+        level = Level.parse(config.get_or_default("LOG_LEVEL", "INFO"))
+        log = logger if logger is not None else new_logger(level)
+        container = cls(config=config, logger=log)
+        container.tracer = new_tracer(config, log)
+        container.register_framework_metrics()
+
+        # remote log level poller (container.go:73-75; remotelogger)
+        remote_url = config.get("REMOTE_LOG_URL")
+        if remote_url:
+            from gofr_tpu.logging.remote_level import start_remote_level_poller
+            interval = config.get_float("REMOTE_LOG_FETCH_INTERVAL", 15.0)
+            start_remote_level_poller(log, remote_url, interval)
+
+        # SQL (container.go:90)
+        dialect = config.get("DB_DIALECT")
+        if dialect:
+            from gofr_tpu.datasource.sql import new_sql
+            container.sql = new_sql(config, log, container.metrics)
+
+        # Redis (container.go:88)
+        if config.get("REDIS_HOST"):
+            from gofr_tpu.datasource.redisx import new_redis
+            container.redis = new_redis(config, log, container.metrics)
+
+        # pub/sub backend switch (container.go:92-143)
+        backend = (config.get("PUBSUB_BACKEND") or "").upper()
+        if backend:
+            from gofr_tpu.datasource.pubsub import new_pubsub
+            container.pubsub = new_pubsub(backend, config, log, container.metrics)
+
+        # file datasource (container.go:145)
+        from gofr_tpu.datasource.file import LocalFileSystem
+        container.file = LocalFileSystem(log)
+
+        # TPU executor (north star; no reference analog)
+        if config.get_bool("TPU_ENABLED", False):
+            from gofr_tpu.tpu import new_executor
+            container.tpu = new_executor(config, log, container.metrics)
+
+        log.debug("container created for app %s@%s (framework %s)",
+                  container.app_name, container.app_version, FRAMEWORK_VERSION)
+        return container
+
+    # -- framework metric catalog (container.go:158-190) --------------------
+    def register_framework_metrics(self) -> None:
+        metrics = self.metrics
+        metrics.new_gauge("app_info", "application name/version info")
+        metrics.new_gauge("threads_total", "live Python threads")
+        metrics.new_gauge("memory_rss_bytes", "resident set size")
+        metrics.new_gauge("gc_objects", "gen-0 tracked objects")
+        metrics.new_gauge("uptime_seconds", "process uptime")
+        metrics.new_histogram("app_http_response",
+                              "inbound HTTP response time (s)",
+                              (0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10, 30))
+        metrics.new_histogram("app_http_service_response",
+                              "outbound HTTP call time (s)",
+                              (0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10, 30))
+        metrics.new_histogram("app_redis_stats", "redis op time (s)",
+                              (0.00005, 0.0001, 0.0003, 0.001, 0.003))
+        metrics.new_histogram("app_sql_stats", "sql query time (s)",
+                              (0.00005, 0.0001, 0.0005, 0.001, 0.01))
+        metrics.new_counter("app_pubsub_publish_total_count", "publish attempts")
+        metrics.new_counter("app_pubsub_publish_success_count", "publishes ok")
+        metrics.new_counter("app_pubsub_subscribe_total_count", "receive attempts")
+        metrics.new_counter("app_pubsub_subscribe_success_count", "receives ok")
+        # TPU catalog (north star: chip liveness + HBM pressure via metrics)
+        metrics.new_histogram("app_tpu_execute", "XLA execute wall time (s)",
+                              (0.0005, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1))
+        metrics.new_histogram("app_tpu_batch_size", "dynamic batch sizes",
+                              (1, 2, 4, 8, 16, 32, 64, 128, 256))
+        metrics.new_gauge("app_tpu_hbm_bytes_in_use", "HBM bytes in use per device")
+        metrics.new_gauge("app_tpu_device_up", "per-device liveness 0/1")
+        metrics.new_counter("app_tpu_requests_total", "TPU predict requests")
+
+    # -- outbound services (container.go:150-152) ---------------------------
+    def add_http_service(self, name: str, service: Any) -> None:
+        self.services[name] = service
+
+    def get_http_service(self, name: str) -> Any:
+        return self.services.get(name)
+
+    # -- aggregated health (container/health.go:8-66) -----------------------
+    def health(self) -> Dict[str, Any]:
+        details: Dict[str, Any] = {
+            "name": self.app_name,
+            "version": self.app_version,
+            "framework": FRAMEWORK_VERSION,
+            "uptime_seconds": round(time.time() - self._start_time, 3),
+        }
+        statuses = []
+        for name in ("sql", "redis", "pubsub", "mongo", "cassandra",
+                     "clickhouse", "tpu"):
+            source = getattr(self, name)
+            if source is None:
+                continue
+            try:
+                health = source.health_check()
+            except Exception as exc:
+                health = {"status": "DOWN", "details": {"error": repr(exc)}}
+            details[name] = health
+            statuses.append(health.get("status", "DOWN"))
+        for name, service in self.services.items():
+            try:
+                health = service.health_check()
+            except Exception as exc:
+                health = {"status": "DOWN", "details": {"error": repr(exc)}}
+            details.setdefault("services", {})[name] = health
+            statuses.append(health.get("status", "DOWN"))
+        details["status"] = "DEGRADED" if "DOWN" in statuses else "UP"
+        return details
+
+    async def close(self) -> None:
+        for name in ("sql", "redis", "pubsub", "tpu"):
+            source = getattr(self, name)
+            closer = getattr(source, "close", None)
+            if closer is not None:
+                try:
+                    result = closer()
+                    if hasattr(result, "__await__"):
+                        await result
+                except Exception:
+                    pass
+
+
+def new_mock_container(config: Optional[Dict[str, str]] = None) -> Container:
+    """One-call test fixture: silent logger + in-memory everything
+    (reference: container/mock_container.go:21-42 ``NewMockContainer``)."""
+    container = Container(config=MapConfig(config or {}),
+                         logger=new_silent_logger())
+    container.register_framework_metrics()
+    from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
+    from gofr_tpu.datasource.file import LocalFileSystem
+    container.pubsub = InMemoryBroker(container.logger, container.metrics)
+    container.file = LocalFileSystem(container.logger)
+    return container

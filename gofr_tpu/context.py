@@ -1,0 +1,106 @@
+"""Context — the single per-request object handed to every handler.
+
+The framework's most important invariant, inherited from the reference
+(context.go:12-27; request.go:10-16): every entry point — HTTP request, gRPC
+call, pub/sub message, cron tick, CLI invocation, websocket frame — converges
+on a ``Context`` embedding (a) the transport-agnostic request, (b) the DI
+container, and (c) a responder. Handlers are therefore transport-independent.
+
+TPU addition: ``ctx.tpu`` exposes the container's TPU executor datasource, so
+``ctx.tpu.predict("resnet50", batch)`` works identically from an HTTP handler,
+a Kafka consumer, or a cron job.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class Context:
+    __slots__ = ("request", "container", "responder", "_span_stack")
+
+    def __init__(self, request: Any, container: Any, responder: Any = None):
+        self.request = request
+        self.container = container
+        self.responder = responder
+        self._span_stack: List[Any] = []
+
+    # -- request passthrough (reference: context embeds Request) ----------
+    def param(self, key: str) -> str:
+        return self.request.param(key)
+
+    def params(self, key: str) -> List[str]:
+        getter = getattr(self.request, "params", None)
+        return getter(key) if getter else []
+
+    def path_param(self, key: str) -> str:
+        return self.request.path_param(key)
+
+    def bind(self, target: Any = None) -> Any:
+        """Decode the request body (context.go:57-59)."""
+        return self.request.bind(target)
+
+    def header(self, key: str) -> str:
+        getter = getattr(self.request, "header", None)
+        return getter(key) if getter else ""
+
+    # -- container accessors -----------------------------------------------
+    @property
+    def logger(self):
+        return self.container.logger
+
+    @property
+    def metrics(self):
+        return self.container.metrics
+
+    @property
+    def config(self):
+        return self.container.config
+
+    @property
+    def sql(self):
+        return self.container.sql
+
+    @property
+    def redis(self):
+        return self.container.redis
+
+    @property
+    def tpu(self):
+        """The TPU executor datasource — the north-star addition
+        (BASELINE.json: handlers call ``ctx.tpu.predict()``)."""
+        return self.container.tpu
+
+    @property
+    def file(self):
+        return self.container.file
+
+    def get_http_service(self, name: str):
+        """Named outbound HTTP service (container/container.go:150-152)."""
+        return self.container.get_http_service(name)
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        """Publish to the configured pub/sub backend."""
+        self.container.pubsub.publish(topic, payload)
+
+    # -- logging sugar ------------------------------------------------------
+    def log(self, message: str, *args, **fields) -> None:
+        self.container.logger.info(message, *args, **fields)
+
+    # -- tracing (context.go:45-55) -----------------------------------------
+    def trace(self, name: str):
+        """Open a user span: ``with ctx.trace("work"):``"""
+        return self.container.tracer.start_span(name)
+
+    # -- websocket passthrough ----------------------------------------------
+    async def read_message(self) -> Any:
+        reader = getattr(self.request, "read_message", None)
+        if reader is None:
+            raise TypeError("context request is not a websocket connection")
+        return await reader()
+
+    async def write_message(self, data: Any) -> None:
+        writer = getattr(self.request, "write_message", None)
+        if writer is None:
+            raise TypeError("context request is not a websocket connection")
+        await writer(data)

@@ -1,0 +1,15 @@
+"""Datasource layer: per-store clients behind narrow interface seams.
+
+Capability parity with ``pkg/gofr/datasource`` (shared Health contract
+health.go:8-11; File contracts file.go:10-63; provider interfaces for
+Mongo/Cassandra/Clickhouse). Every datasource exposes ``health_check()``
+returning ``{"status": "UP"|"DOWN", "details": {...}}`` so the container can
+aggregate deep health.
+"""
+
+UP = "UP"
+DOWN = "DOWN"
+
+
+def health(status: str, **details) -> dict:
+    return {"status": status, "details": details}

@@ -1,0 +1,38 @@
+"""Pub/sub datasource layer.
+
+Capability parity with ``pkg/gofr/datasource/pubsub`` (interface.go:11-30
+Publisher/Subscriber/Client/Committer contracts; message.go:13-107 Message
+implementing the transport-agnostic Request contract) with backends:
+
+- ``INMEM``  — in-process broker (test double + single-process apps); the
+  analog of testing pub/sub without a broker (SURVEY.md §4).
+- ``MQTT``   — pure-Python MQTT 3.1.1 wire client (reference: pubsub/mqtt).
+- ``KAFKA``  — pure-Python Kafka wire-protocol client (reference: pubsub/kafka).
+- ``GOOGLE`` — gated: requires google-cloud-pubsub, absent in this image.
+"""
+
+from __future__ import annotations
+
+from gofr_tpu.datasource.pubsub.base import Message, PubSub
+
+__all__ = ["Message", "PubSub", "new_pubsub"]
+
+
+def new_pubsub(backend: str, config, logger, metrics) -> PubSub:
+    """Backend switch from config (reference: container/container.go:92-143)."""
+    backend = backend.upper()
+    if backend in ("INMEM", "MEMORY"):
+        from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
+        return InMemoryBroker(logger, metrics)
+    if backend == "MQTT":
+        from gofr_tpu.datasource.pubsub.mqtt import MQTTClient
+        return MQTTClient(config, logger, metrics)
+    if backend == "KAFKA":
+        from gofr_tpu.datasource.pubsub.kafka import KafkaClient
+        return KafkaClient(config, logger, metrics)
+    if backend == "GOOGLE":
+        raise RuntimeError(
+            "GOOGLE pub/sub backend requires google-cloud-pubsub, which is "
+            "not available in this image; use KAFKA, MQTT, or INMEM"
+        )
+    raise ValueError(f"unknown PUBSUB_BACKEND {backend!r}")

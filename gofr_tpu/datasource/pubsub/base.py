@@ -1,0 +1,91 @@
+"""Pub/sub contracts and the Message request adapter.
+
+Reference: ``pkg/gofr/datasource/pubsub/interface.go:11-30`` (Publisher,
+Subscriber, Committer, Client) and ``message.go:13-107`` (Message satisfies
+the framework Request contract: ``param("topic")``, scalar/JSON ``bind``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+class Message:
+    """A received message; doubles as the handler's ``request``."""
+
+    __slots__ = ("topic", "value", "key", "metadata", "_committer", "committed")
+
+    def __init__(self, topic: str, value: bytes, key: bytes = b"",
+                 metadata: Optional[Dict[str, Any]] = None, committer=None):
+        self.topic = topic
+        self.value = value
+        self.key = key
+        self.metadata = metadata or {}
+        self._committer = committer
+        self.committed = False
+
+    # -- Request contract (pubsub/message.go:35-107) ------------------------
+    def param(self, key: str) -> str:
+        if key == "topic":
+            return self.topic
+        return str(self.metadata.get(key, ""))
+
+    def path_param(self, key: str) -> str:
+        return self.param(key)
+
+    def bind(self, target: Any = None) -> Any:
+        """Scalar or JSON decode of the payload (message.go:60-107)."""
+        text = self.value.decode("utf-8", "replace")
+        if target is None:
+            try:
+                return json.loads(text)
+            except ValueError:
+                return text
+        if target in (str,):
+            return text
+        if target in (int, float):
+            return target(text.strip())
+        try:
+            data = json.loads(text)
+        except ValueError as exc:
+            raise ValueError(f"cannot bind message on {self.topic!r}") from exc
+        from gofr_tpu.http.request import _bind_into
+        return _bind_into(target, data)
+
+    def header(self, key: str) -> str:
+        return str(self.metadata.get(key, ""))
+
+    # -- Committer contract (interface.go:27-30) ----------------------------
+    def commit(self) -> None:
+        if self._committer is not None and not self.committed:
+            self._committer()
+        self.committed = True
+
+    def to_log(self):
+        return {"topic": self.topic, "bytes": len(self.value)}
+
+
+class PubSub:
+    """Client contract: Publisher + Subscriber + topic admin + health
+    (interface.go:19-26)."""
+
+    def publish(self, topic: str, payload: bytes, key: bytes = b"") -> None:
+        raise NotImplementedError
+
+    async def subscribe(self, topic: str) -> Optional[Message]:
+        """Blocking receive of one message from the topic (returns None on
+        backend shutdown)."""
+        raise NotImplementedError
+
+    def create_topic(self, topic: str) -> None:
+        raise NotImplementedError
+
+    def delete_topic(self, topic: str) -> None:
+        raise NotImplementedError
+
+    def health_check(self) -> dict:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass

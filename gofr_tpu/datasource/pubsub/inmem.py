@@ -1,0 +1,74 @@
+"""In-process pub/sub broker.
+
+The test double and single-process backend — the role miniredis/mocked Kafka
+readers play in the reference's test strategy (SURVEY.md §4). Topics are
+asyncio queues; consumer groups see each message once (queue semantics, like
+a Kafka consumer group with one partition).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+from typing import Dict, Optional
+
+from gofr_tpu.datasource import UP, health
+from gofr_tpu.datasource.pubsub.base import Message, PubSub
+
+
+class InMemoryBroker(PubSub):
+    def __init__(self, logger=None, metrics=None, maxsize: int = 65536):
+        self.logger = logger
+        self.metrics = metrics
+        self.maxsize = maxsize
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._published = 0
+        self._delivered = 0
+        self._closed = False
+
+    def _queue(self, topic: str) -> asyncio.Queue:
+        queue = self._queues.get(topic)
+        if queue is None:
+            queue = asyncio.Queue(maxsize=self.maxsize)
+            self._queues[topic] = queue
+        return queue
+
+    def publish(self, topic: str, payload: bytes, key: bytes = b"") -> None:
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_pubsub_publish_total_count",
+                                           topic=topic)
+        try:
+            self._queue(topic).put_nowait((payload, key))
+            self._published += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_pubsub_publish_success_count", topic=topic)
+        except asyncio.QueueFull:
+            if self.logger is not None:
+                self.logger.error("inmem broker: topic %s full, dropping", topic)
+
+    async def subscribe(self, topic: str) -> Optional[Message]:
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_pubsub_subscribe_total_count",
+                                           topic=topic)
+        if self._closed:
+            return None
+        payload, key = await self._queue(topic).get()
+        self._delivered += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_pubsub_subscribe_success_count",
+                                           topic=topic)
+        return Message(topic, payload, key, committer=lambda: None)
+
+    def create_topic(self, topic: str) -> None:
+        self._queue(topic)
+
+    def delete_topic(self, topic: str) -> None:
+        self._queues.pop(topic, None)
+
+    def health_check(self) -> dict:
+        return health(UP, backend="INMEM", topics=len(self._queues),
+                      published=self._published, delivered=self._delivered)
+
+    def close(self) -> None:
+        self._closed = True

@@ -1,0 +1,85 @@
+"""Handler adapter: user handler → wire handler, with timeout + panic isolation.
+
+Capability parity with ``pkg/gofr/handler.go`` (``Handler`` 22,
+``ServeHTTP`` 43-96: per-request goroutine + select over done/timeout/panic
+63-92; built-ins healthHandler 98, liveHandler 102, faviconHandler 108,
+catchAllHandler 120).
+
+Python analog of the reference's goroutine+select: async handlers run under
+``asyncio.wait_for``; plain ``def`` handlers are shipped to a thread pool so
+blocking datasource calls never stall the event loop — the same "every
+handler gets its own execution context" guarantee. An escaped exception
+becomes a 500 without touching the server loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Callable, Optional
+
+from gofr_tpu.context import Context
+from gofr_tpu.http.errors import HTTPError, InvalidRoute, PanicRecovery, RequestTimeout
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import Responder
+
+Handler = Callable[[Context], Any]
+
+_responder = Responder()
+
+
+def wrap_handler(func: Handler, container, timeout: Optional[float] = None):
+    """Build the wire handler for one route (handler.go:43-96)."""
+    is_async = asyncio.iscoroutinefunction(func)
+
+    async def wire_handler(request: Request):
+        ctx = Context(request, container, _responder)
+        try:
+            if is_async:
+                coro: Any = func(ctx)
+            else:
+                loop = asyncio.get_running_loop()
+                coro = loop.run_in_executor(None, func, ctx)
+            if timeout is not None and timeout > 0:
+                result = await asyncio.wait_for(coro, timeout)
+            else:
+                result = await coro
+            if asyncio.iscoroutine(result):  # sync handler returned a coro
+                result = await result
+            error = None
+        except asyncio.TimeoutError:
+            result, error = None, RequestTimeout()
+        except HTTPError as exc:
+            result, error = None, exc
+        except Exception as exc:  # "panic" isolation (handler.go:71-92)
+            container.logger.error("handler panic: %r", exc,
+                                   uri=request.path, method=request.method)
+            if hasattr(exc, "status_code"):
+                result, error = None, exc
+            else:
+                result, error = None, PanicRecovery(str(exc))
+        return _responder.respond(result, error, request.method)
+
+    return wire_handler
+
+
+# -- built-in handlers (handler.go:98-126) ----------------------------------
+
+def make_health_handler(container):
+    async def health_handler(request: Request):
+        loop = asyncio.get_running_loop()
+        body = await loop.run_in_executor(None, container.health)
+        return 200, {"Content-Type": "application/json"}, json.dumps(body).encode()
+    return health_handler
+
+
+async def live_handler(request: Request):
+    return 200, {"Content-Type": "application/json"}, b'{"status":"UP"}'
+
+
+async def favicon_handler(request: Request):
+    return 204, {}, b""
+
+
+async def catch_all_handler(request: Request):
+    return _responder.respond(None, InvalidRoute(), request.method)

@@ -1,0 +1,32 @@
+from gofr_tpu.http.errors import (
+    EntityAlreadyExists,
+    EntityNotFound,
+    HTTPError,
+    InvalidParam,
+    InvalidRoute,
+    MissingParam,
+    PanicRecovery,
+    RequestTimeout,
+)
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import Responder
+from gofr_tpu.http.response import FileResponse, Raw, Redirect, Response
+from gofr_tpu.http.router import Router
+
+__all__ = [
+    "EntityAlreadyExists",
+    "EntityNotFound",
+    "HTTPError",
+    "InvalidParam",
+    "InvalidRoute",
+    "MissingParam",
+    "PanicRecovery",
+    "RequestTimeout",
+    "Request",
+    "Responder",
+    "Response",
+    "Raw",
+    "FileResponse",
+    "Redirect",
+    "Router",
+]

@@ -1,0 +1,17 @@
+from gofr_tpu.http.middleware.tracer import tracing_middleware
+from gofr_tpu.http.middleware.logger import logging_middleware
+from gofr_tpu.http.middleware.cors import cors_middleware
+from gofr_tpu.http.middleware.metrics import metrics_middleware
+from gofr_tpu.http.middleware.basic_auth import basic_auth_middleware
+from gofr_tpu.http.middleware.apikey_auth import api_key_auth_middleware
+from gofr_tpu.http.middleware.oauth import oauth_middleware
+
+__all__ = [
+    "tracing_middleware",
+    "logging_middleware",
+    "cors_middleware",
+    "metrics_middleware",
+    "basic_auth_middleware",
+    "api_key_auth_middleware",
+    "oauth_middleware",
+]

@@ -1,0 +1,26 @@
+"""Metrics middleware: per-request latency histogram.
+
+Capability parity with ``pkg/gofr/http/middleware/metrics.go:21-42``
+(``app_http_response`` histogram labeled path/method/status).
+"""
+
+from __future__ import annotations
+
+import time
+
+from gofr_tpu.http.router import Middleware, WireHandler
+from gofr_tpu.metrics import Manager
+
+
+def metrics_middleware(manager: Manager) -> Middleware:
+    def middleware(next_handler: WireHandler) -> WireHandler:
+        async def handle(request):
+            start = time.perf_counter()
+            status, headers, body = await next_handler(request)
+            manager.record_histogram(
+                "app_http_response", time.perf_counter() - start,
+                path=request.path, method=request.method, status=str(status),
+            )
+            return status, headers, body
+        return handle
+    return middleware

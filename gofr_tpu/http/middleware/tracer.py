@@ -1,0 +1,30 @@
+"""Tracing middleware: extract W3C tracecontext, open a request span.
+
+Capability parity with ``pkg/gofr/http/middleware/tracer.go:15-32`` (span
+named ``"METHOD /path"`` parented on the incoming ``traceparent``).
+"""
+
+from __future__ import annotations
+
+from gofr_tpu.http.router import Middleware, WireHandler
+from gofr_tpu.trace import Tracer, extract_traceparent
+
+
+def tracing_middleware(tracer: Tracer) -> Middleware:
+    def middleware(next_handler: WireHandler) -> WireHandler:
+        async def handle(request):
+            remote = extract_traceparent(request.headers.get("traceparent"))
+            span = tracer.start_span(
+                f"{request.method} {request.path}", remote_parent=remote
+            )
+            with span:
+                span.set_attribute("http.method", request.method)
+                span.set_attribute("http.target", request.path)
+                request.context_values["span"] = span
+                status, headers, body = await next_handler(request)
+                span.set_attribute("http.status_code", status)
+                if status >= 500:
+                    span.set_status("ERROR")
+                return status, headers, body
+        return handle
+    return middleware

@@ -1,0 +1,43 @@
+"""Response value types.
+
+Capability parity with ``pkg/gofr/http/response`` (response/raw.go raw
+payloads, response/file.go file downloads) plus an explicit ``Response`` for
+full control and ``Redirect``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class Raw:
+    """Return the payload as-is, skipping the ``{"data": ...}`` envelope
+    (reference: response/raw.go)."""
+
+    data: Any
+
+
+@dataclass
+class FileResponse:
+    """Serve raw bytes with a content type (reference: response/file.go)."""
+
+    content: bytes
+    content_type: str = "application/octet-stream"
+
+
+@dataclass
+class Redirect:
+    location: str
+    status_code: int = 302
+
+
+@dataclass
+class Response:
+    """Fully-specified response: body + status + headers."""
+
+    data: Any = None
+    status_code: int = 200
+    headers: Dict[str, str] = field(default_factory=dict)
+    content_type: Optional[str] = None

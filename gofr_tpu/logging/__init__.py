@@ -1,0 +1,9 @@
+from gofr_tpu.logging.logger import (
+    Level,
+    Logger,
+    new_logger,
+    new_file_logger,
+    new_silent_logger,
+)
+
+__all__ = ["Level", "Logger", "new_logger", "new_file_logger", "new_silent_logger"]

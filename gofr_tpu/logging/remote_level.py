@@ -1,0 +1,53 @@
+"""Remote live log-level updates.
+
+Capability parity with ``pkg/gofr/logging/remotelogger``
+(dynamicLevelLogger.go:23-71): poll ``REMOTE_LOG_URL`` every
+``REMOTE_LOG_FETCH_INTERVAL`` seconds and apply the returned level to the
+running logger without restart. Expected response JSON:
+``{"data": [{"serviceLevel": {"logLevel": "DEBUG"}}]}`` or the simpler
+``{"level": "DEBUG"}``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+from gofr_tpu.logging.logger import Level, Logger
+
+
+def _extract_level(doc) -> str:
+    if isinstance(doc, dict):
+        if "level" in doc:
+            return str(doc["level"])
+        data = doc.get("data")
+        if isinstance(data, list) and data:
+            service_level = data[0].get("serviceLevel", {})
+            return str(service_level.get("logLevel", ""))
+    return ""
+
+
+def start_remote_level_poller(logger: Logger, url: str,
+                              interval: float = 15.0) -> threading.Thread:
+    def poll_loop() -> None:
+        import time
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    doc = json.loads(resp.read())
+                name = _extract_level(doc)
+                if name:
+                    new_level = Level.parse(name, logger.level)
+                    if new_level != logger.level:
+                        logger.info("remote log level change: %s -> %s",
+                                    logger.level.name, new_level.name)
+                        logger.change_level(new_level)
+            except Exception:
+                pass
+            time.sleep(interval)
+
+    thread = threading.Thread(target=poll_loop, name="remote-log-level",
+                              daemon=True)
+    thread.start()
+    return thread

@@ -1,0 +1,4 @@
+from gofr_tpu.metrics.manager import Manager, MetricsError, new_manager
+from gofr_tpu.metrics.exposition import render_prometheus
+
+__all__ = ["Manager", "MetricsError", "new_manager", "render_prometheus"]

@@ -1,0 +1,68 @@
+"""Prometheus text exposition format (v0.0.4) rendered from the Manager store.
+
+The reference exports via the OTel->Prometheus bridge
+(metrics/exporters/exporter.go:14-29); here we render the format directly —
+fewer moving parts and no dependency on prometheus_client internals.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from gofr_tpu.metrics.manager import Manager
+
+_KIND_TO_PROM = {
+    "counter": "counter",
+    "updown": "gauge",
+    "gauge": "gauge",
+    "histogram": "histogram",
+}
+
+
+def _fmt_labels(key) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_float(value: float) -> str:
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def render_prometheus(manager: "Manager") -> str:
+    lines = []
+    for name, metric in sorted(manager.snapshot().items()):
+        prom_kind = _KIND_TO_PROM[metric.kind]
+        if metric.desc:
+            lines.append(f"# HELP {name} {metric.desc}")
+        lines.append(f"# TYPE {name} {prom_kind}")
+        if metric.kind == "histogram":
+            for key, state in sorted(metric.series.items()):
+                assert isinstance(state, dict)
+                cumulative = 0
+                for bound, count in zip(metric.buckets, state["buckets"]):
+                    cumulative += count
+                    le_labels = dict(key)
+                    le_labels["le"] = _fmt_float(bound)
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(tuple(sorted(le_labels.items())))} {cumulative}"
+                    )
+                inf_labels = dict(key)
+                inf_labels["le"] = "+Inf"
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(tuple(sorted(inf_labels.items())))} {state['count']}"
+                )
+                lines.append(f"{name}_sum{_fmt_labels(key)} {_fmt_float(state['sum'])}")
+                lines.append(f"{name}_count{_fmt_labels(key)} {state['count']}")
+        else:
+            for key, value in sorted(metric.series.items()):
+                lines.append(f"{name}{_fmt_labels(key)} {_fmt_float(float(value))}")  # type: ignore[arg-type]
+    return "\n".join(lines) + "\n"

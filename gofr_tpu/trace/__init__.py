@@ -1,0 +1,17 @@
+from gofr_tpu.trace.tracer import (
+    Span,
+    Tracer,
+    current_span,
+    extract_traceparent,
+    format_traceparent,
+    new_tracer,
+)
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "current_span",
+    "extract_traceparent",
+    "format_traceparent",
+    "new_tracer",
+]

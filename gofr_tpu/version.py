@@ -1,0 +1,7 @@
+"""Framework version stamp.
+
+Mirrors the reference's ``pkg/gofr/version`` (version/version.go:3): a single
+constant stamped into logs, metrics resources, and tracer names.
+"""
+
+FRAMEWORK_VERSION = "0.1.0"

@@ -1,0 +1,25 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before the first ``import jax`` anywhere in the test session so the
+multi-chip sharding paths (gofr_tpu.parallel) are exercised without TPU
+hardware — the "miniredis of XLA" strategy from SURVEY.md §4.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# keep XLA quiet + snappy in unit tests
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def mock_container():
+    from gofr_tpu.container import new_mock_container
+    return new_mock_container()

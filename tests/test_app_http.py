@@ -1,0 +1,219 @@
+"""End-to-end tests over the real asyncio HTTP server (the reference's
+examples/*/main_test.go style: start the app, fire real HTTP — SURVEY.md §4)."""
+
+import asyncio
+import json
+
+from gofr_tpu.http.errors import EntityNotFound
+
+from tests.util import http_request, make_app, run, serving
+
+
+def test_hello_roundtrip():
+    async def main():
+        app = make_app()
+        app.get("/hello", lambda ctx: {
+            "message": f"Hello {ctx.param('name') or 'World'}!"})
+        async with serving(app) as port:
+            result = await http_request(port, "GET", "/hello?name=TPU")
+            assert result.status == 200
+            assert result.json() == {"data": {"message": "Hello TPU!"}}
+            assert "x-correlation-id" in result.headers
+            assert result.headers["access-control-allow-origin"] == "*"
+    run(main())
+
+
+def test_post_binding_and_status():
+    async def main():
+        app = make_app()
+
+        def create(ctx):
+            data = ctx.bind()
+            return {"id": 1, "name": data["name"]}
+
+        app.post("/items", create)
+        async with serving(app) as port:
+            result = await http_request(
+                port, "POST", "/items", body=json.dumps({"name": "n"}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert result.status == 201
+            assert result.json()["data"]["name"] == "n"
+    run(main())
+
+
+def test_path_params_and_errors():
+    async def main():
+        app = make_app()
+
+        def get_item(ctx):
+            if ctx.path_param("id") != "1":
+                raise EntityNotFound("id", ctx.path_param("id"))
+            return {"id": 1}
+
+        app.get("/items/{id}", get_item)
+        async with serving(app) as port:
+            ok = await http_request(port, "GET", "/items/1")
+            assert ok.status == 200
+            missing = await http_request(port, "GET", "/items/2")
+            assert missing.status == 404
+            assert "No entity found" in missing.json()["error"]["message"]
+    run(main())
+
+
+def test_catch_all_and_method_not_allowed():
+    async def main():
+        app = make_app()
+        app.get("/only-get", lambda ctx: "ok")
+        async with serving(app) as port:
+            nothing = await http_request(port, "GET", "/zzz")
+            assert nothing.status == 404
+            wrong = await http_request(port, "POST", "/only-get")
+            assert wrong.status == 405
+    run(main())
+
+
+def test_panic_isolation():
+    async def main():
+        app = make_app()
+
+        def boom(ctx):
+            raise RuntimeError("kaboom")
+
+        app.get("/boom", boom)
+        async with serving(app) as port:
+            result = await http_request(port, "GET", "/boom")
+            assert result.status == 500
+            assert "message" in result.json()["error"]
+            # server still alive afterwards
+            alive = await http_request(port, "GET", "/.well-known/alive")
+            assert alive.status == 200
+    run(main())
+
+
+def test_request_timeout():
+    async def main():
+        app = make_app({"REQUEST_TIMEOUT": "0.1"})
+        app._request_timeout = 0.1
+
+        async def slow(ctx):
+            await asyncio.sleep(5)
+            return "never"
+
+        app.get("/slow", slow)
+        async with serving(app) as port:
+            result = await http_request(port, "GET", "/slow")
+            assert result.status == 408
+    run(main())
+
+
+def test_health_and_alive_and_favicon():
+    async def main():
+        app = make_app()
+        async with serving(app) as port:
+            health = await http_request(port, "GET", "/.well-known/health")
+            assert health.status == 200
+            doc = health.json()
+            assert doc["status"] == "UP"
+            assert doc["pubsub"]["status"] == "UP"
+            alive = await http_request(port, "GET", "/.well-known/alive")
+            assert alive.json() == {"status": "UP"}
+            fav = await http_request(port, "GET", "/favicon.ico")
+            assert fav.status == 204
+    run(main())
+
+
+def test_metrics_server_scrape():
+    async def main():
+        app = make_app()
+        app.get("/x", lambda ctx: "ok")
+        async with serving(app) as port:
+            await http_request(port, "GET", "/x")
+            mport = app._metrics_server.bound_port
+            scrape = await http_request(mport, "GET", "/metrics")
+            assert scrape.status == 200
+            text = scrape.body.decode()
+            assert "app_http_response_count" in text
+            assert "app_info" in text
+    run(main())
+
+
+def test_cors_preflight():
+    async def main():
+        app = make_app()
+        app.post("/api", lambda ctx: "ok")
+        async with serving(app) as port:
+            preflight = await http_request(port, "OPTIONS", "/api")
+            assert preflight.status == 200
+            assert "POST" in preflight.headers["access-control-allow-methods"]
+    run(main())
+
+
+def test_basic_auth():
+    async def main():
+        app = make_app()
+        app.enable_basic_auth({"admin": "secret"})
+        app.get("/private", lambda ctx: "in")
+        async with serving(app) as port:
+            anon = await http_request(port, "GET", "/private")
+            assert anon.status == 401
+            import base64
+            token = base64.b64encode(b"admin:secret").decode()
+            ok = await http_request(port, "GET", "/private",
+                                    headers={"Authorization": f"Basic {token}"})
+            assert ok.status == 200
+            bad = await http_request(port, "GET", "/private",
+                                     headers={"Authorization": "Basic deadbeef"})
+            assert bad.status == 401
+            # health bypasses auth (validate.go:5-7)
+            health = await http_request(port, "GET", "/.well-known/alive")
+            assert health.status == 200
+    run(main())
+
+
+def test_api_key_auth():
+    async def main():
+        app = make_app()
+        app.enable_api_key_auth("k1")
+        app.get("/private", lambda ctx: "in")
+        async with serving(app) as port:
+            anon = await http_request(port, "GET", "/private")
+            assert anon.status == 401
+            ok = await http_request(port, "GET", "/private",
+                                    headers={"X-API-KEY": "k1"})
+            assert ok.status == 200
+    run(main())
+
+
+def test_keep_alive_two_requests_one_connection():
+    async def main():
+        app = make_app()
+        app.get("/a", lambda ctx: "a")
+        async with serving(app) as port:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            req = (f"GET /a HTTP/1.1\r\nHost: x\r\n\r\n").encode()
+            writer.write(req)
+            await writer.drain()
+            first = await reader.readuntil(b'{"data": "a"}')
+            assert b"200 OK" in first
+            writer.write(req)
+            await writer.drain()
+            second = await reader.readuntil(b'{"data": "a"}')
+            assert b"200 OK" in second
+            writer.close()
+            await writer.wait_closed()
+    run(main())
+
+
+def test_async_handler():
+    async def main():
+        app = make_app()
+
+        async def async_handler(ctx):
+            await asyncio.sleep(0.001)
+            return {"async": True}
+
+        app.get("/async", async_handler)
+        async with serving(app) as port:
+            result = await http_request(port, "GET", "/async")
+            assert result.json()["data"]["async"] is True
+    run(main())

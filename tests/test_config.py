@@ -1,0 +1,56 @@
+import os
+
+from gofr_tpu.config import EnvConfig, MapConfig, load_env_file
+
+
+def test_load_env_file(tmp_path):
+    env = tmp_path / ".env"
+    env.write_text(
+        "# comment\n"
+        "APP_NAME=svc\n"
+        "export HTTP_PORT=8123\n"
+        'QUOTED="hello world"\n'
+        "SINGLE='x'\n"
+        "INLINE=abc # trailing\n"
+        "BROKENLINE\n"
+    )
+    values = load_env_file(str(env))
+    assert values == {
+        "APP_NAME": "svc",
+        "HTTP_PORT": "8123",
+        "QUOTED": "hello world",
+        "SINGLE": "x",
+        "INLINE": "abc",
+    }
+
+
+def test_env_overlay_app_env(tmp_path):
+    (tmp_path / ".env").write_text("A=base\nB=base\nAPP_ENV=stage\n")
+    (tmp_path / ".stage.env").write_text("B=stage\n")
+    config = EnvConfig(str(tmp_path), environ={})
+    assert config.get("A") == "base"
+    assert config.get("B") == "stage"
+
+
+def test_env_overlay_local_default(tmp_path):
+    (tmp_path / ".env").write_text("A=base\n")
+    (tmp_path / ".local.env").write_text("A=local\n")
+    config = EnvConfig(str(tmp_path), environ={})
+    assert config.get("A") == "local"
+
+
+def test_process_env_wins(tmp_path):
+    (tmp_path / ".env").write_text("A=file\n")
+    config = EnvConfig(str(tmp_path), environ={"A": "proc"})
+    assert config.get("A") == "proc"
+
+
+def test_typed_getters():
+    config = MapConfig({"I": "42", "F": "2.5", "B": "true", "BAD": "xx"})
+    assert config.get_int("I", 0) == 42
+    assert config.get_int("BAD", 7) == 7
+    assert config.get_int("MISSING", 7) == 7
+    assert config.get_float("F", 0.0) == 2.5
+    assert config.get_bool("B") is True
+    assert config.get_bool("MISSING", True) is True
+    assert config.get_or_default("MISSING", "d") == "d"

@@ -1,0 +1,48 @@
+import time
+
+import pytest
+
+from gofr_tpu.cron import CronJob, CronParseError, parse_schedule
+
+
+def test_parse_wildcards():
+    sched = parse_schedule("* * * * *")
+    assert sched["minute"] == set(range(60))
+    assert sched["dow"] == set(range(7))
+
+
+def test_parse_steps_ranges_lists():
+    sched = parse_schedule("*/15 9-17 1,15 * 1-5")
+    assert sched["minute"] == {0, 15, 30, 45}
+    assert sched["hour"] == set(range(9, 18))
+    assert sched["day"] == {1, 15}
+    assert sched["dow"] == {1, 2, 3, 4, 5}
+
+
+def test_parse_range_with_step():
+    sched = parse_schedule("0-30/10 * * * *")
+    assert sched["minute"] == {0, 10, 20, 30}
+
+
+def test_parse_rejects_garbage():
+    for bad in ("* * * *", "61 * * * *", "* 25 * * *", "x * * * *",
+                "*/0 * * * *", "5-2 * * * *"):
+        with pytest.raises(CronParseError):
+            parse_schedule(bad)
+
+
+def test_job_due():
+    job = CronJob("30 12 * * *", "lunch", lambda ctx: None)
+    when = time.struct_time((2026, 7, 29, 12, 30, 0, 2, 210, 0))  # Wed
+    assert job.due(when)
+    when_off = time.struct_time((2026, 7, 29, 12, 31, 0, 2, 210, 0))
+    assert not job.due(when_off)
+
+
+def test_job_due_dow():
+    # cron dow: 0=Sunday. struct_time tm_wday: 0=Monday.
+    job = CronJob("* * * * 0", "sundays", lambda ctx: None)
+    sunday = time.struct_time((2026, 8, 2, 1, 0, 0, 6, 214, 0))  # tm_wday=6
+    monday = time.struct_time((2026, 8, 3, 1, 0, 0, 0, 215, 0))
+    assert job.due(sunday)
+    assert not job.due(monday)

@@ -1,0 +1,55 @@
+import io
+import json
+
+from gofr_tpu.logging import Level, Logger
+
+
+def make_logger(level=Level.INFO):
+    out, err = io.StringIO(), io.StringIO()
+    return Logger(level=level, out=out, err=err), out, err
+
+
+def test_json_lines_to_pipe():
+    logger, out, _ = make_logger()
+    logger.info("hello %s", "world", component="test")
+    entry = json.loads(out.getvalue())
+    assert entry["level"] == "INFO"
+    assert entry["message"] == "hello world"
+    assert entry["component"] == "test"
+
+
+def test_level_filtering():
+    logger, out, err = make_logger(Level.WARN)
+    logger.debug("nope")
+    logger.info("nope")
+    logger.warn("yes")
+    assert out.getvalue().count("\n") == 1
+    logger.error("to stderr")
+    assert "to stderr" in err.getvalue()
+
+
+def test_change_level():
+    logger, out, _ = make_logger(Level.ERROR)
+    logger.info("dropped")
+    logger.change_level(Level.DEBUG)
+    logger.debug("kept")
+    assert "kept" in out.getvalue()
+    assert "dropped" not in out.getvalue()
+
+
+def test_level_parse():
+    assert Level.parse("debug") == Level.DEBUG
+    assert Level.parse("WARN") == Level.WARN
+    assert Level.parse("bogus") == Level.INFO
+
+
+def test_payload_serialization():
+    logger, out, _ = make_logger()
+
+    class QueryLog:
+        def to_log(self):
+            return {"query": "SELECT 1", "duration_us": 12}
+
+    logger.info("query", payload=QueryLog())
+    entry = json.loads(out.getvalue())
+    assert entry["payload"]["query"] == "SELECT 1"

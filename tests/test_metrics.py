@@ -1,0 +1,48 @@
+from gofr_tpu.metrics import Manager, render_prometheus
+
+
+def test_counter_and_labels():
+    manager = Manager()
+    manager.new_counter("hits", "total hits")
+    manager.increment_counter("hits", path="/a")
+    manager.increment_counter("hits", path="/a")
+    manager.increment_counter("hits", path="/b")
+    assert manager.value("hits", path="/a") == 2
+    assert manager.value("hits", path="/b") == 1
+
+
+def test_label_name_collision_with_positional():
+    manager = Manager()
+    manager.new_gauge("app_info")
+    manager.set_gauge("app_info", 1.0, name="svc", version="1.2")
+    assert manager.value("app_info", name="svc", version="1.2") == 1.0
+
+
+def test_histogram_buckets():
+    manager = Manager()
+    manager.new_histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+    for value in (0.005, 0.05, 0.5, 5.0):
+        manager.record_histogram("lat", value)
+    text = render_prometheus(manager)
+    assert 'lat_bucket{le="0.01"} 1' in text
+    assert 'lat_bucket{le="0.1"} 2' in text
+    assert 'lat_bucket{le="1"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+
+
+def test_wrong_kind_is_noop():
+    manager = Manager()
+    manager.new_counter("c")
+    manager.set_gauge("c", 5.0)  # wrong kind: logged, not raised
+    assert manager.value("c") is None
+
+
+def test_updown_and_exposition_format():
+    manager = Manager()
+    manager.new_updown_counter("inflight")
+    manager.delta_updown_counter("inflight", 3)
+    manager.delta_updown_counter("inflight", -1)
+    text = render_prometheus(manager)
+    assert "# TYPE inflight gauge" in text
+    assert "inflight 2" in text

@@ -1,0 +1,66 @@
+import asyncio
+import json
+
+from tests.util import make_app, run, serving
+
+
+def test_publish_subscribe_roundtrip():
+    async def main():
+        app = make_app()
+        received = asyncio.Event()
+        seen = {}
+
+        def on_order(ctx):
+            seen["data"] = ctx.bind()
+            seen["topic"] = ctx.request.param("topic")
+            received.set()
+
+        app.subscribe("orders", on_order)
+        async with serving(app):
+            app.container.pubsub.publish(
+                "orders", json.dumps({"id": 7}).encode())
+            await asyncio.wait_for(received.wait(), timeout=5)
+        assert seen["data"] == {"id": 7}
+        assert seen["topic"] == "orders"
+    run(main())
+
+
+def test_subscriber_panic_does_not_kill_loop():
+    async def main():
+        app = make_app()
+        calls = []
+        done = asyncio.Event()
+
+        def flaky(ctx):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("first message explodes")
+            done.set()
+
+        app.subscribe("t", flaky)
+        async with serving(app):
+            app.container.pubsub.publish("t", b"1")
+            app.container.pubsub.publish("t", b"2")
+            await asyncio.wait_for(done.wait(), timeout=5)
+        assert len(calls) == 2
+    run(main())
+
+
+def test_message_bind_scalars():
+    from gofr_tpu.datasource.pubsub.base import Message
+    msg = Message("t", b"42")
+    assert msg.bind(int) == 42
+    assert msg.bind(str) == "42"
+    msg2 = Message("t", b'{"a": 1}')
+    assert msg2.bind() == {"a": 1}
+    msg3 = Message("t", b"not-json")
+    assert msg3.bind() == "not-json"
+
+
+def test_commit_on_success_semantics():
+    from gofr_tpu.datasource.pubsub.base import Message
+    committed = []
+    msg = Message("t", b"x", committer=lambda: committed.append(1))
+    msg.commit()
+    msg.commit()
+    assert committed == [1]  # idempotent

@@ -1,0 +1,42 @@
+from gofr_tpu.trace import (
+    Tracer,
+    current_span,
+    extract_traceparent,
+    format_traceparent,
+)
+
+
+def test_span_nesting_and_context():
+    tracer = Tracer()
+    assert current_span() is None
+    with tracer.start_span("outer") as outer:
+        assert current_span() is outer
+        with tracer.start_span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        assert current_span() is outer
+    assert current_span() is None
+
+
+def test_traceparent_roundtrip():
+    tracer = Tracer()
+    with tracer.start_span("s") as span:
+        header = format_traceparent(span)
+        parsed = extract_traceparent(header)
+        assert parsed == {"trace_id": span.trace_id, "span_id": span.span_id}
+
+
+def test_extract_rejects_garbage():
+    assert extract_traceparent(None) is None
+    assert extract_traceparent("") is None
+    assert extract_traceparent("00-zz-aa-01") is None
+    assert extract_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+
+
+def test_remote_parent_adopted():
+    tracer = Tracer()
+    remote = {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+    span = tracer.start_span("req", remote_parent=remote)
+    assert span.trace_id == "ab" * 16
+    assert span.parent_id == "cd" * 8
+    span.finish()

@@ -1,0 +1,69 @@
+"""Shared test helpers: run an App on ephemeral ports + tiny HTTP client."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from gofr_tpu.app import App
+from gofr_tpu.container import new_mock_container
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_app(config: Optional[Dict[str, str]] = None) -> App:
+    container = new_mock_container(config)
+    app = App(config=container.config, container=container)
+    app.http_port = 0
+    app.metrics_port = 0
+    return app
+
+
+class HTTPResult:
+    def __init__(self, status: int, headers: Dict[str, str], body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return json.loads(self.body.decode())
+
+
+async def http_request(port: int, method: str, path: str,
+                       body: bytes = b"",
+                       headers: Optional[Dict[str, str]] = None) -> HTTPResult:
+    """Minimal raw HTTP/1.1 client — also exercises our server's parser."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        head = f"{method} {path} HTTP/1.1\r\nHost: 127.0.0.1:{port}\r\n"
+        for key, value in (headers or {}).items():
+            head += f"{key}: {value}\r\n"
+        head += f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+        writer.write(head.encode() + body)
+        await writer.drain()
+        raw = await reader.read()
+    finally:
+        writer.close()
+        with contextlib.suppress(Exception):
+            await writer.wait_closed()
+    header_blob, _, payload = raw.partition(b"\r\n\r\n")
+    lines = header_blob.split(b"\r\n")
+    status = int(lines[0].split()[1])
+    resp_headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        name, _, value = line.decode("latin-1").partition(":")
+        resp_headers[name.strip().lower()] = value.strip()
+    return HTTPResult(status, resp_headers, payload)
+
+
+@contextlib.asynccontextmanager
+async def serving(app: App):
+    await app.start()
+    try:
+        yield app._http_server.bound_port
+    finally:
+        await app.stop()
