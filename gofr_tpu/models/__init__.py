@@ -1,0 +1,9 @@
+"""Servable model zoo (north star, BASELINE.json): ResNet-50 classify,
+BERT-base embeddings, Llama generate. The Go reference ships no models
+(SURVEY.md §2.7) — these are original TPU-first designs; see each module's
+docstring for the design rules (bf16/MXU, stacked-scan layers, static
+shapes, sharding-annotation-only parallelism)."""
+
+from gofr_tpu.models import bert, llama, resnet
+
+__all__ = ["bert", "llama", "resnet"]

@@ -1,0 +1,134 @@
+"""BERT-base encoder for the streaming-embeddings gRPC path.
+
+North star config 3 (BASELINE.json): "grpc-server streaming BERT-base
+embeddings (dynamic batching)". No reference analog (SURVEY.md §2.7).
+Same TPU-first recipe as llama.py: stacked layers + ``lax.scan``, bf16
+matmuls, fp32 norms/softmax, static shapes (fixed max_len with an
+attention mask so every batch compiles to the same executable — the
+dynamic batcher pads into these buckets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gofr_tpu.ops import attention, layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+PRESETS = {
+    "tiny": BertConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                       ffn_dim=128, max_len=64),
+    "base": BertConfig(),
+}
+
+
+def config(preset: str = "base", **overrides) -> BertConfig:
+    return dataclasses.replace(PRESETS[preset], **overrides)
+
+
+def init(cfg: BertConfig, key: jax.Array) -> Dict[str, Any]:
+    keys = jax.random.split(key, 12)
+    dt = cfg.dtype
+    d, f, l_count = cfg.dim, cfg.ffn_dim, cfg.n_layers
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dt)
+
+    return {
+        "tok_emb": dense(keys[0], (cfg.vocab_size, d), d),
+        "pos_emb": dense(keys[1], (cfg.max_len, d), d),
+        "type_emb": dense(keys[2], (cfg.type_vocab, d), d),
+        "emb_norm_w": jnp.ones((d,), dt),
+        "emb_norm_b": jnp.zeros((d,), dt),
+        "layers": {
+            "wq": dense(keys[3], (l_count, d, d), d),
+            "wk": dense(keys[4], (l_count, d, d), d),
+            "wv": dense(keys[5], (l_count, d, d), d),
+            "wo": dense(keys[6], (l_count, d, d), d),
+            "bq": jnp.zeros((l_count, d), dt),
+            "bk": jnp.zeros((l_count, d), dt),
+            "bv": jnp.zeros((l_count, d), dt),
+            "bo": jnp.zeros((l_count, d), dt),
+            "attn_norm_w": jnp.ones((l_count, d), dt),
+            "attn_norm_b": jnp.zeros((l_count, d), dt),
+            "w_in": dense(keys[7], (l_count, d, f), d),
+            "b_in": jnp.zeros((l_count, f), dt),
+            "w_out": dense(keys[8], (l_count, f, d), f),
+            "b_out": jnp.zeros((l_count, d), dt),
+            "ffn_norm_w": jnp.ones((l_count, d), dt),
+            "ffn_norm_b": jnp.zeros((l_count, d), dt),
+        },
+        "pool_w": dense(keys[9], (d, d), d),
+        "pool_b": jnp.zeros((d,), dt),
+    }
+
+
+def apply(params: Dict[str, Any], cfg: BertConfig, token_ids: jnp.ndarray,
+          attention_mask: jnp.ndarray | None = None,
+          type_ids: jnp.ndarray | None = None) -> Dict[str, jnp.ndarray]:
+    """token_ids (B, S) int32 → {"sequence": (B,S,D), "pooled": (B,D),
+    "mean": (B,D)} — mean is the masked mean-pooled embedding (the usual
+    sentence-embedding output)."""
+    b, s = token_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+    if type_ids is None:
+        type_ids = jnp.zeros((b, s), jnp.int32)
+
+    x = (params["tok_emb"][token_ids]
+         + params["pos_emb"][None, :s]
+         + params["type_emb"][type_ids])
+    x = layer_norm(x, params["emb_norm_w"], params["emb_norm_b"], cfg.norm_eps)
+
+    # (B,1,1,1,T) mask matching ops.attention's grouped-score layout
+    mask = attention_mask[:, None, None, None, :].astype(bool)
+
+    def body(x, layer):
+        q = (x @ layer["wq"] + layer["bq"]).reshape(b, s, cfg.n_heads,
+                                                    cfg.head_dim)
+        k = (x @ layer["wk"] + layer["bk"]).reshape(b, s, cfg.n_heads,
+                                                    cfg.head_dim)
+        v = (x @ layer["wv"] + layer["bv"]).reshape(b, s, cfg.n_heads,
+                                                    cfg.head_dim)
+        attn = attention(q, k, v, mask).reshape(b, s, -1)
+        x = layer_norm(x + attn @ layer["wo"] + layer["bo"],
+                       layer["attn_norm_w"], layer["attn_norm_b"],
+                       cfg.norm_eps)
+        h = jax.nn.gelu((x @ layer["w_in"] + layer["b_in"])
+                        .astype(jnp.float32)).astype(x.dtype)
+        x = layer_norm(x + h @ layer["w_out"] + layer["b_out"],
+                       layer["ffn_norm_w"], layer["ffn_norm_b"], cfg.norm_eps)
+        return x, None
+
+    x, _ = lax.scan(body, x, params["layers"])
+
+    pooled = jnp.tanh((x[:, 0] @ params["pool_w"] + params["pool_b"])
+                      .astype(jnp.float32))
+    weights = attention_mask.astype(jnp.float32)[..., None]
+    mean = ((x.astype(jnp.float32) * weights).sum(axis=1)
+            / jnp.maximum(weights.sum(axis=1), 1.0))
+    return {"sequence": x, "pooled": pooled, "mean": mean}

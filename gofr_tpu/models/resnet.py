@@ -1,0 +1,124 @@
+"""ResNet-50 (v1.5) for the /classify serving path.
+
+North star config 2 (BASELINE.json): "http-server + ResNet-50 classify
+endpoint ... ≥1000 req/s/chip, p99 < 10 ms". No reference analog
+(SURVEY.md §2.7). TPU-first choices:
+
+- **NHWC layout** (TPU conv native) with HWIO kernels; bf16 weights and
+  activations so convs run on the MXU.
+- **Inference-mode BatchNorm folded to scale+shift** per conv — XLA fuses
+  these into the conv epilogue, which is exactly the fusion a hand-written
+  kernel would do.
+- Python loops over blocks unroll at trace time (static depth), giving XLA
+  one flat graph to fuse/tile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (3, 4, 6, 3)           # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    image_size: int = 224
+    dtype: Any = jnp.bfloat16
+
+
+PRESETS = {
+    "tiny": ResNetConfig(stage_sizes=(1, 1, 1, 1), width=8, image_size=32,
+                         num_classes=10),
+    "50": ResNetConfig(),
+}
+
+
+def config(preset: str = "50", **overrides) -> ResNetConfig:
+    return dataclasses.replace(PRESETS[preset], **overrides)
+
+
+def _conv_params(key, kh, kw, c_in, c_out, dtype):
+    fan_in = kh * kw * c_in
+    k1, _ = jax.random.split(key)
+    return {
+        "w": (jax.random.normal(k1, (kh, kw, c_in, c_out), jnp.float32)
+              * math.sqrt(2.0 / fan_in)).astype(dtype),
+        # folded BatchNorm: y = conv(x) * scale + shift
+        "scale": jnp.ones((c_out,), dtype),
+        "shift": jnp.zeros((c_out,), dtype),
+    }
+
+
+def _conv(x, p, stride=1, padding="SAME"):
+    y = lax.conv_general_dilated(
+        x, p["w"], window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y * p["scale"] + p["shift"]
+
+
+def init(cfg: ResNetConfig, key: jax.Array) -> Dict[str, Any]:
+    keys = iter(jax.random.split(key, 256))
+    dt = cfg.dtype
+    params: Dict[str, Any] = {
+        "stem": _conv_params(next(keys), 7, 7, 3, cfg.width, dt),
+    }
+    c_in = cfg.width
+    stages: List[Any] = []
+    for stage_idx, n_blocks in enumerate(cfg.stage_sizes):
+        c_mid = cfg.width * (2 ** stage_idx)
+        c_out = c_mid * 4
+        blocks = []
+        for block_idx in range(n_blocks):
+            stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
+            block = {
+                "conv1": _conv_params(next(keys), 1, 1, c_in, c_mid, dt),
+                "conv2": _conv_params(next(keys), 3, 3, c_mid, c_mid, dt),
+                "conv3": _conv_params(next(keys), 1, 1, c_mid, c_out, dt),
+            }
+            if stride != 1 or c_in != c_out:
+                block["proj"] = _conv_params(next(keys), 1, 1, c_in, c_out, dt)
+            blocks.append(block)
+            c_in = c_out
+        stages.append(blocks)
+    params["stages"] = stages
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (c_in, cfg.num_classes),
+                                jnp.float32) / math.sqrt(c_in)).astype(dt),
+        "b": jnp.zeros((cfg.num_classes,), dt),
+    }
+    return params
+
+
+def _bottleneck(x, block, stride):
+    # stride lives on the 3x3 (the v1.5 variant — better accuracy, and the
+    # strided 3x3 tiles onto the MXU better than a strided 1x1)
+    residual = x
+    y = jax.nn.relu(_conv(x, block["conv1"], 1))
+    y = jax.nn.relu(_conv(y, block["conv2"], stride))
+    y = _conv(y, block["conv3"], 1)
+    if "proj" in block:
+        residual = _conv(x, block["proj"], stride)
+    return jax.nn.relu(y + residual)
+
+
+def apply(params: Dict[str, Any], cfg: ResNetConfig,
+          images: jnp.ndarray) -> jnp.ndarray:
+    """images (B, H, W, 3) → logits (B, num_classes) fp32."""
+    x = images.astype(cfg.dtype)
+    x = jax.nn.relu(_conv(x, params["stem"], stride=2))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    for stage_idx, blocks in enumerate(params["stages"]):
+        for block_idx, block in enumerate(blocks):
+            stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
+            x = _bottleneck(x, block, stride)
+    x = jnp.mean(x, axis=(1, 2))                       # global average pool
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits.astype(jnp.float32)

@@ -1,0 +1,67 @@
+"""Attention ops for the serving path (BASELINE.json north star).
+
+TPU-first design notes:
+- Scores/softmax accumulate in fp32; Q/K/V stay bf16 so the two einsums hit
+  the MXU. XLA fuses scale+mask+softmax between them.
+- GQA is expressed by reshaping Q to (kv_heads, group, ...) and letting the
+  einsum broadcast over the group axis — no materialised `repeat_kv` copy,
+  which matters at 7B scale where KV is the HBM-bandwidth bottleneck.
+- Decode attends over a static-shape KV cache with a length mask instead of
+  a dynamic slice, so one compiled executable serves every cache fill level.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
+
+
+def causal_mask(seq_len: int) -> jnp.ndarray:
+    """(seq, seq) boolean mask, True where attention is allowed."""
+    return jnp.tril(jnp.ones((seq_len, seq_len), dtype=bool))
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Multi-head (optionally grouped-query) attention.
+
+    q: (B, S, Hq, D); k, v: (B, T, Hkv, D) with Hq % Hkv == 0.
+    mask: broadcastable to (B, 1, 1, S, T), True = attend.
+    Returns (B, S, Hq, D) in q.dtype.
+    """
+    batch, s_len, q_heads, head_dim = q.shape
+    kv_heads = k.shape[2]
+    group = q_heads // kv_heads
+    qg = q.reshape(batch, s_len, kv_heads, group, head_dim)
+
+    scale = head_dim ** -0.5
+    # (B, Hkv, G, S, T) — contraction on head_dim feeds the MXU
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(q.dtype), v)
+    return out.reshape(batch, s_len, q_heads, head_dim)
+
+
+def prefill_attention(q, k, v) -> jnp.ndarray:
+    """Causal self-attention over a full prompt (prefill phase)."""
+    s_len = q.shape[1]
+    mask = causal_mask(s_len)[None, None, None, :, :]
+    return attention(q, k, v, mask)
+
+
+def decode_attention(q, k_cache, v_cache, cache_len) -> jnp.ndarray:
+    """One-token decode against a static-shape KV cache.
+
+    q: (B, 1, Hq, D); caches: (B, Tmax, Hkv, D); cache_len: (B,) int32 —
+    number of valid cache entries per sequence (the new token's K/V must
+    already be written at position cache_len-1 ... i.e. caller scatters
+    first, then calls with the post-write length).
+    """
+    t_max = k_cache.shape[1]
+    valid = jnp.arange(t_max)[None, :] < cache_len[:, None]    # (B, Tmax)
+    mask = valid[:, None, None, None, :]                       # (B,1,1,1,T)
+    return attention(q, k_cache, v_cache, mask)

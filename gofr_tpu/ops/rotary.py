@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE) for the Llama serving path.
+
+TPU-first details: the cos/sin tables are precomputed once per max length
+(static shape, lives in HBM alongside weights) and gathered with a static
+slice or integer positions — no dynamic shapes under jit. Rotation is done
+in fp32 then cast back so bf16 Q/K keep precision at long context.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(max_len: int, head_dim: int, theta: float = 10000.0):
+    """Precompute (max_len, head_dim/2) cos/sin tables in fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    positions = jnp.arange(max_len, dtype=jnp.float32)
+    angles = jnp.outer(positions, inv_freq)          # (max_len, head_dim/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` of shape (batch, seq, heads, head_dim).
+
+    ``positions`` is (batch, seq) int32 — absolute positions, so the same
+    function serves prefill (0..S-1) and single-token decode (cache_len).
+    """
+    dtype = x.dtype
+    cos_g = cos[positions][:, :, None, :]            # (B, S, 1, D/2)
+    sin_g = sin[positions][:, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * cos_g - x2 * sin_g, x2 * cos_g + x1 * sin_g], axis=-1)
+    return rotated.astype(dtype)

@@ -1,20 +1,24 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before the first ``import jax`` anywhere in the test session so the
-multi-chip sharding paths (gofr_tpu.parallel) are exercised without TPU
-hardware — the "miniredis of XLA" strategy from SURVEY.md §4.
+The image boots with ``JAX_PLATFORMS=axon`` (one real TPU chip behind a
+relay); unit tests must instead exercise the multi-chip sharding paths
+(gofr_tpu.parallel) on a virtual 8-device CPU mesh — the "miniredis of
+XLA" strategy from SURVEY.md §4.  ``jax.config.update`` beats the env var
+even though the axon sitecustomize imported jax at interpreter start.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# keep XLA quiet + snappy in unit tests
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -23,3 +27,9 @@ import pytest  # noqa: E402
 def mock_container():
     from gofr_tpu.container import new_mock_container
     return new_mock_container()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh():
+    """2×4 dp×tp mesh over the 8 virtual CPU devices."""
+    return jax.make_mesh((2, 4), ("dp", "tp"))
