@@ -125,18 +125,33 @@ def _ffn(layer, x):
     return (gate * up).astype(x.dtype) @ layer["w_down"]
 
 
-def forward(params: Dict[str, Any], cfg: LlamaConfig,
-            tokens: jnp.ndarray) -> jnp.ndarray:
-    """Full causal forward → logits (B, S, V) in fp32. Training/eval path."""
+def forward(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
+            mesh=None, sp_axis: str = "sp", dp_axis: str = "dp",
+            tp_axis: str = "tp") -> jnp.ndarray:
+    """Full causal forward → logits (B, S, V) in fp32. Training/eval path.
+
+    With ``mesh`` given (long-context sequence parallelism), attention runs
+    as ring attention over the ``sp_axis`` ring — K/V blocks rotate via
+    ppermute over ICI, composing with dp (batch) and tp (heads) sharding.
+    """
     b, s = tokens.shape
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     x = params["tok_emb"][tokens]
 
+    if mesh is not None:
+        from gofr_tpu.parallel.ring_attention import ring_attention
+
+        def attend(q, k, v):
+            return ring_attention(q, k, v, mesh, axis_name=sp_axis,
+                                  batch_axis=dp_axis, head_axis=tp_axis)
+    else:
+        attend = prefill_attention
+
     def body(x, layer):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
-        attn = prefill_attention(q, k, v).reshape(b, s, -1)
+        attn = attend(q, k, v).reshape(b, s, -1)
         x = x + attn @ layer["wo"]
         h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
         x = x + _ffn(layer, h)
@@ -245,10 +260,10 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
 
 
 def loss_fn(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
-            targets: jnp.ndarray) -> jnp.ndarray:
+            targets: jnp.ndarray, mesh=None) -> jnp.ndarray:
     """Next-token cross-entropy — the training-step objective used by
     gofr_tpu.parallel.train and the driver's dryrun_multichip."""
-    logits = forward(params, cfg, tokens)
+    logits = forward(params, cfg, tokens, mesh=mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()

@@ -1,0 +1,99 @@
+"""Ring attention: exact causal attention over sequence-sharded Q/K/V.
+
+Long-context sequence parallelism (no reference analog — SURVEY.md §5
+"long-context: absent"; first-class here per the build goal). Each device
+holds a contiguous sequence shard; K/V blocks rotate around the ``sp``
+ring via ``lax.ppermute`` (ICI neighbour exchange) while a flash-style
+online softmax accumulates (m, l, acc) in fp32 — so the full (S, S) score
+matrix never materialises and per-device memory is O(S_local · S_local).
+
+Design: one ``lax.fori_loop`` over ring steps inside ``shard_map``;
+each step is one GQA block-attention (MXU) + one ppermute, which XLA
+overlaps (compute on block i while block i+1 is in flight on ICI).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, causal):
+    """One GQA block: q (B,S,Hq,D) vs k/v (B,T,Hkv,D); fp32 partial-softmax
+    stats. Returns (scores_exp @ v, row_max, row_sum) with shapes
+    ((B,S,Hq,D) f32, (B,K,G,S) f32, (B,K,G,S) f32)."""
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, s, hkv, g, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
+    scores = scores * (d ** -0.5)
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]            # (S, T)
+        scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    m = scores.max(axis=-1)                                # (B,K,G,S)
+    p = jnp.exp(scores - m[..., None])
+    l = p.sum(axis=-1)                                     # (B,K,G,S)
+    pv = jnp.einsum("bkgst,btkd->bskgd", p, v.astype(jnp.float32))
+    return pv.reshape(b, s, hq, d), m, l
+
+
+def _ring_body(q, k, v, axis_name: str, causal: bool):
+    """Runs on one shard inside shard_map."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    q_pos = my * s + jnp.arange(s)
+
+    acc = jnp.zeros((b, s, hq, d), jnp.float32)
+    m = jnp.full((b, hkv, g, s), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hkv, g, s), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        k_blk, v_blk, acc, m, l = carry
+        blk_idx = (my - i) % n
+        k_pos = blk_idx * s + jnp.arange(s)
+        pv, m_blk, l_blk = _block_attend(q, k_blk, v_blk, q_pos, k_pos,
+                                         causal)
+        m_new = jnp.maximum(m, m_blk)
+        corr_old = jnp.exp(m - m_new)
+        corr_blk = jnp.exp(m_blk - m_new)
+        l = l * corr_old + l_blk * corr_blk
+        # broadcast (B,K,G,S) stats onto (B,S,Hq,D) accumulators
+        def to_act(stat):
+            return stat.transpose(0, 3, 1, 2).reshape(b, s, hq)[..., None]
+        acc = acc * to_act(corr_old) + pv * to_act(corr_blk)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, acc, m_new, l
+
+    _, _, acc, m, l = lax.fori_loop(0, n, step, (k, v, acc, m, l))
+    l_act = l.transpose(0, 3, 1, 2).reshape(b, s, hq)[..., None]
+    return (acc / jnp.maximum(l_act, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = True,
+                   batch_axis: Optional[str] = None,
+                   head_axis: Optional[str] = None) -> jnp.ndarray:
+    """Exact (causal) attention with Q/K/V sharded on the sequence axis.
+
+    q (B, S, Hq, D), k/v (B, S, Hkv, D) — S sharded ``axis_name``-ways,
+    optionally B on ``batch_axis`` (dp) and heads on ``head_axis`` (tp),
+    so sp composes with dp×tp without gathering heads. Returns q's sharding.
+    """
+    spec = P(batch_axis, axis_name, head_axis, None)
+    body = functools.partial(_ring_body, axis_name=axis_name, causal=causal)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

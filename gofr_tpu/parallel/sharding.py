@@ -1,0 +1,94 @@
+"""Sharding rules: map param pytrees to PartitionSpecs.
+
+The whole tensor-parallel design is annotation-only (no collective calls in
+model code): Megatron-style column/row parallel pairs —
+
+  wq/wk/wv, w_gate/w_up : column-parallel (shard output features on ``tp``)
+  wo, w_down            : row-parallel   (shard input features on ``tp``)
+
+so each attention/FFN block needs exactly one all-reduce on its output,
+which XLA inserts automatically from these specs and runs over ICI.
+Layers are stacked (L, ...) so every spec carries a leading ``None``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def shard_pytree(tree: Any, mesh: Mesh, specs: Any) -> Any:
+    """device_put every leaf to its NamedSharding (specs mirrors tree)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
+
+
+def replicated_specs(tree: Any) -> Any:
+    return jax.tree.map(lambda _: P(), tree)
+
+
+def prune_specs(specs: Any, mesh: Mesh) -> Any:
+    """Drop axis names the mesh doesn't have (→ replicated on that dim), so
+    one canonical rule-set serves every mesh topology."""
+    def prune(spec: P) -> P:
+        return P(*(axis if axis in mesh.shape else None for axis in spec))
+    return jax.tree.map(prune, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def llama_param_specs(tp: str = "tp") -> Dict[str, Any]:
+    """PartitionSpecs mirroring gofr_tpu.models.llama param pytree."""
+    return {
+        "tok_emb": P(None, None),        # replicated: lookup stays local
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, tp),     # column parallel
+            "wk": P(None, None, tp),
+            "wv": P(None, None, tp),
+            "wo": P(None, tp, None),     # row parallel → all-reduce out
+            "ffn_norm": P(None, None),
+            "w_gate": P(None, None, tp),
+            "w_up": P(None, None, tp),
+            "w_down": P(None, tp, None),
+        },
+        "out_norm": P(None,),
+        "lm_head": P(None, tp),          # vocab-sharded logits
+    }
+
+
+def llama_cache_specs(dp: str = "dp", tp: str = "tp") -> Dict[str, P]:
+    """KV cache (L, B, T, Hkv, Dh): batch on dp, kv-heads on tp."""
+    spec = P(None, dp, None, tp, None)
+    return {"k": spec, "v": spec}
+
+
+def bert_param_specs(tp: str = "tp") -> Dict[str, Any]:
+    """PartitionSpecs mirroring gofr_tpu.models.bert param pytree."""
+    return {
+        "tok_emb": P(None, None),
+        "pos_emb": P(None, None),
+        "type_emb": P(None, None),
+        "emb_norm_w": P(None,), "emb_norm_b": P(None,),
+        "layers": {
+            "wq": P(None, None, tp), "wk": P(None, None, tp),
+            "wv": P(None, None, tp), "wo": P(None, tp, None),
+            "bq": P(None, tp), "bk": P(None, tp), "bv": P(None, tp),
+            "bo": P(None, None),
+            "attn_norm_w": P(None, None), "attn_norm_b": P(None, None),
+            "w_in": P(None, None, tp), "b_in": P(None, tp),
+            "w_out": P(None, tp, None), "b_out": P(None, None),
+            "ffn_norm_w": P(None, None), "ffn_norm_b": P(None, None),
+        },
+        "pool_w": P(None, None), "pool_b": P(None,),
+    }
+
+
+def batch_spec(dp: str = "dp", ndim: int = 2) -> P:
+    """Shard the leading (batch) axis on dp, replicate the rest."""
+    return P(dp, *([None] * (ndim - 1)))

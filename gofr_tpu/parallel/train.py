@@ -1,0 +1,86 @@
+"""Sharded training step: dp × tp × sp over one jit'd update.
+
+No reference analog (SURVEY.md §2.8 — GoFr has no training). This is the
+full-scale path the driver's ``dryrun_multichip`` validates: params are
+tensor-parallel (Megatron column/row specs from sharding.py), the batch is
+data-parallel, the sequence axis rides ring attention, and the optimizer
+state inherits param shardings. All cross-device traffic is XLA-inserted
+collectives (psum for grads over dp, all-reduce in tp blocks, ppermute in
+the sp ring) riding ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gofr_tpu.models import llama
+from gofr_tpu.parallel.sharding import (
+    llama_param_specs,
+    prune_specs,
+    shard_pytree,
+)
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
+                    learning_rate: float = 3e-4,
+                    use_sp: bool = False,
+                    remat: bool = False):
+    """Returns (init_fn, step_fn).
+
+    init_fn(key) → TrainState with params laid out tensor-parallel on the
+    mesh and optimizer moments inheriting the same shardings.
+    step_fn(state, tokens, targets) → (state, loss); donates state.
+    ``remat`` wraps the loss in jax.checkpoint — rematerialise activations
+    to trade FLOPs for HBM (the standard TPU memory lever).
+    """
+    optimizer = optax.adamw(learning_rate)
+    param_specs = prune_specs(llama_param_specs(), mesh)
+    has_sp = use_sp and "sp" in mesh.shape
+    batch_sharding = NamedSharding(
+        mesh, P("dp", "sp") if has_sp else P("dp"))
+
+    def init_fn(key: jax.Array) -> TrainState:
+        params = shard_pytree(llama.init(cfg, key), mesh, param_specs)
+        # jit so moment tensors are created directly with param shardings
+        opt_state = jax.jit(optimizer.init)(params)
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    loss = lambda p, t, y: llama.loss_fn(p, cfg, t, y,
+                                         mesh=mesh if has_sp else None)
+    if remat:
+        loss = jax.checkpoint(loss)
+
+    def step_fn(state: TrainState, tokens: jnp.ndarray,
+                targets: jnp.ndarray) -> Tuple[TrainState, jnp.ndarray]:
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        targets = jax.lax.with_sharding_constraint(targets, batch_sharding)
+        loss_val, grads = jax.value_and_grad(loss)(state.params, tokens,
+                                                   targets)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss_val
+
+    return init_fn, jax.jit(step_fn, donate_argnums=(0,))
+
+
+def make_eval_step(cfg: llama.LlamaConfig, mesh: Mesh):
+    """Data/tensor-parallel forward returning mean loss."""
+    batch_sharding = NamedSharding(mesh, P("dp"))
+
+    def eval_fn(params, tokens, targets):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        return llama.loss_fn(params, cfg, tokens, targets)
+
+    return jax.jit(eval_fn)

@@ -1,0 +1,89 @@
+"""Dynamic batching: coalesce concurrent single requests into one execute.
+
+North star (BASELINE.json): "dynamic-batching middleware that coalesces
+concurrent requests into a single XLA execute". This is the throughput
+lever for ≥1000 req/s/chip: the MXU wants batch dimensions, HTTP delivers
+single examples.
+
+Design: one accumulator per model on the app's asyncio loop (zero locks on
+the hot path — the loop serializes). The first request arms a
+``max_delay`` timer; the batch flushes on whichever comes first of
+max_batch or the timer. The device step runs in a worker thread so the
+event loop keeps accepting requests while XLA executes — giving pipelined
+batches: batch N on device while batch N+1 accumulates. Composes with the
+per-request timeout/panic isolation the handler layer guarantees
+(reference semantics: /root/reference/pkg/gofr/handler.go:63-92): a
+request future that is cancelled simply never gets its slice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class _Pending:
+    __slots__ = ("examples", "futures", "timer")
+
+    def __init__(self):
+        self.examples: List[Any] = []
+        self.futures: List[asyncio.Future] = []
+        self.timer: Optional[asyncio.TimerHandle] = None
+
+
+class DynamicBatcher:
+    def __init__(self, executor, max_batch: int = 32,
+                 max_delay_ms: float = 2.0, logger=None):
+        self.executor = executor
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1000.0
+        self.logger = logger
+        self._pending: Dict[str, _Pending] = {}
+
+    async def predict(self, name: str, example: Any) -> Any:
+        """Submit ONE example (no batch axis); returns its result slice."""
+        loop = asyncio.get_running_loop()
+        pending = self._pending.setdefault(name, _Pending())
+        future: asyncio.Future = loop.create_future()
+        pending.examples.append(example)
+        pending.futures.append(future)
+        if len(pending.examples) >= self.max_batch:
+            self._flush(name)
+        elif pending.timer is None:
+            pending.timer = loop.call_later(self.max_delay,
+                                            self._flush, name)
+        return await future
+
+    def _flush(self, name: str) -> None:
+        pending = self._pending.get(name)
+        if pending is None or not pending.examples:
+            return
+        if pending.timer is not None:
+            pending.timer.cancel()
+        self._pending[name] = _Pending()
+        examples, futures = pending.examples, pending.futures
+        asyncio.ensure_future(self._run(name, examples, futures))
+
+    async def _run(self, name: str, examples: List[Any],
+                   futures: List[asyncio.Future]) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            import jax
+            batch = jax.tree.map(
+                lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
+                *examples)
+            # device step off-loop: next batch accumulates while this runs
+            result = await loop.run_in_executor(
+                None, self.executor.predict, name, batch)
+            for i, future in enumerate(futures):
+                if not future.done():  # request may have timed out/gone
+                    future.set_result(
+                        jax.tree.map(lambda l: np.asarray(l)[i], result))
+        except Exception as exc:
+            if self.logger is not None:
+                self.logger.error("tpu batch %s failed: %r", name, exc)
+            for future in futures:
+                if not future.done():
+                    future.set_exception(exc)

@@ -1,0 +1,221 @@
+"""TPU executor — the container datasource that owns compiled XLA programs.
+
+North star (BASELINE.json): "handlers call ``ctx.tpu.predict()`` which
+dispatches through an in-process client that loads modules into TPU HBM".
+In this framework the PJRT client is JAX itself (jax → XLA → libtpu); the
+executor's job is everything around it, mirroring how GoFr's datasources
+wrap driver libs with config/logging/metrics/health (e.g.
+/root/reference/pkg/gofr/datasource/sql/sql.go:37-92):
+
+- **Bucketed AOT compilation**: XLA traces once per static shape, so the
+  executor compiles each model at a ladder of batch sizes (1,2,4,...) and
+  pads every request batch up to the next bucket — one warm executable per
+  bucket, zero recompiles at serve time.
+- **Weights resident in HBM**: params are device_put once at register time
+  (sharded over a mesh when given — tp for Llama, dp for batch serving).
+- **Health/metrics**: per-device liveness probe + HBM occupancy gauges
+  feed the same health aggregation GoFr applies to SQL/Redis
+  (/root/reference/pkg/gofr/container/health.go:8-66).
+- Narrow interface + in-process CPU fallback = the "miniredis of XLA"
+  test story (SURVEY.md §4): the identical executor runs on the CPU
+  backend in unit tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _pad_batch(leaf: np.ndarray, bucket: int) -> np.ndarray:
+    n = leaf.shape[0]
+    if n == bucket:
+        return leaf
+    pad = [(0, bucket - n)] + [(0, 0)] * (leaf.ndim - 1)
+    return np.pad(leaf, pad)
+
+
+class _Model:
+    def __init__(self, name: str, fn: Callable, params: Any,
+                 buckets: Sequence[int]):
+        self.name = name
+        self.fn = fn
+        self.params = params
+        self.buckets = tuple(sorted(buckets))
+        self.compiled: Dict[int, Callable] = {}
+        self.lock = threading.Lock()
+
+
+class Executor:
+    """Owns registered models, their compiled executables, and device health.
+
+    ``fn(params, inputs)`` must be jit-compatible; ``inputs`` is one array
+    or a tuple of arrays whose leading axis is the batch.
+    """
+
+    def __init__(self, logger, metrics, mesh=None, batch_axis: str = "dp",
+                 donate_cache: bool = False):
+        import jax
+        self._jax = jax
+        self.logger = logger
+        self.metrics = metrics
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self._models: Dict[str, _Model] = {}
+        self.devices = jax.devices()
+        self._up = {d.id: True for d in self.devices}
+
+    # -- registration (analog of datasource connect) ------------------------
+    def register(self, name: str, fn: Callable, params: Any,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 param_specs: Any = None) -> None:
+        """Put weights on device (sharded if a mesh + specs are given) and
+        set up the compile-bucket ladder."""
+        jax = self._jax
+        if self.mesh is not None and param_specs is not None:
+            from gofr_tpu.parallel.sharding import shard_pytree
+            params = shard_pytree(params, self.mesh, param_specs)
+        else:
+            params = jax.device_put(params)
+        jitted = jax.jit(fn)
+        model = _Model(name, jitted, params, buckets)
+        self._models[name] = model
+        self.logger.info("tpu: model %s registered (buckets=%s, mesh=%s)",
+                         name, list(buckets),
+                         dict(self.mesh.shape) if self.mesh else None)
+
+    def models(self) -> Sequence[str]:
+        return list(self._models)
+
+    def warmup(self, name: str, example: Any) -> None:
+        """Pre-compile every bucket from one example input (no cold-start
+        compiles on the serving path)."""
+        model = self._models[name]
+        leaves = self._leaves(example)
+        for bucket in model.buckets:
+            batch = self._tree_unflatten(
+                example, [np.repeat(l[None], bucket, axis=0) for l in leaves])
+            self._execute(model, batch, bucket)
+
+    # -- predict (the hot path) ---------------------------------------------
+    def predict(self, name: str, inputs: Any) -> Any:
+        """Synchronous batched predict. ``inputs`` leading axis = batch; it
+        is padded up to the next compiled bucket and results are sliced
+        back. Single-example calls (no batch axis) go through
+        ``predict_one``/the dynamic batcher instead."""
+        model = self._models.get(name)
+        if model is None:
+            raise KeyError(f"tpu model {name!r} not registered "
+                           f"(have {list(self._models)})")
+        leaves = self._leaves(inputs)
+        n = leaves[0].shape[0]
+        bucket = next((b for b in model.buckets if b >= n), None)
+        if bucket is None:  # larger than biggest bucket: split
+            bucket = model.buckets[-1]
+            outs = [self.predict(name, self._tree_unflatten(
+                inputs, [l[i:i + bucket] for l in leaves]))
+                for i in range(0, n, bucket)]
+            return self._tree_concat(outs)
+        start = time.perf_counter()
+        padded = self._tree_unflatten(
+            inputs, [_pad_batch(np.asarray(l), bucket) for l in leaves])
+        out = self._execute(model, padded, bucket)
+        elapsed = time.perf_counter() - start
+        self.metrics.record_histogram("app_tpu_execute", elapsed, model=name)
+        self.metrics.record_histogram("app_tpu_batch_size", float(n),
+                                      model=name)
+        self.metrics.increment_counter("app_tpu_requests_total", model=name)
+        return self._jax.tree.map(lambda l: np.asarray(l)[:n], out)
+
+    def _execute(self, model: _Model, padded: Any, bucket: int) -> Any:
+        compiled = model.compiled.get(bucket)
+        if compiled is None:
+            with model.lock:
+                compiled = model.compiled.get(bucket)
+                if compiled is None:
+                    t0 = time.perf_counter()
+                    args = self._constrain(padded)
+                    compiled = model.fn.lower(model.params,
+                                              args).compile()
+                    model.compiled[bucket] = compiled
+                    self.logger.info(
+                        "tpu: compiled %s bucket=%d in %.1fs", model.name,
+                        bucket, time.perf_counter() - t0)
+        out = compiled(model.params, self._constrain(padded))
+        return self._jax.block_until_ready(out)
+
+    def _constrain(self, inputs: Any):
+        jax = self._jax
+        if self.mesh is not None and self.batch_axis in self.mesh.shape:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            def put(leaf):
+                arr = jax.numpy.asarray(leaf)
+                spec = P(self.batch_axis, *([None] * (arr.ndim - 1)))
+                return jax.device_put(arr, NamedSharding(self.mesh, spec))
+            return jax.tree.map(put, inputs)
+        return jax.tree.map(jax.numpy.asarray, inputs)
+
+    # -- pytree plumbing ----------------------------------------------------
+    def _leaves(self, inputs: Any):
+        return self._jax.tree.leaves(inputs)
+
+    def _tree_unflatten(self, like: Any, leaves):
+        treedef = self._jax.tree.structure(like)
+        return self._jax.tree.unflatten(treedef, leaves)
+
+    def _tree_concat(self, outs):
+        return self._jax.tree.map(
+            lambda *ls: np.concatenate([np.asarray(l) for l in ls]), *outs)
+
+    # -- health (container/health.go analog, per-chip) ----------------------
+    def health_check(self) -> Dict[str, Any]:
+        details: Dict[str, Any] = {"backend": self.devices[0].platform,
+                                   "devices": {}}
+        all_up = True
+        for device in self.devices:
+            stats = {}
+            try:
+                mem = device.memory_stats() or {}
+                stats = {"hbm_bytes_in_use": mem.get("bytes_in_use", 0),
+                         "hbm_bytes_limit": mem.get("bytes_limit", 0)}
+                self.metrics.set_gauge("app_tpu_hbm_bytes_in_use",
+                                       float(mem.get("bytes_in_use", 0)),
+                                       device=str(device.id))
+                up = True
+            except Exception as exc:  # chip unreachable
+                stats = {"error": repr(exc)}
+                up = False
+                all_up = False
+            self._up[device.id] = up
+            self.metrics.set_gauge("app_tpu_device_up", 1.0 if up else 0.0,
+                                   device=str(device.id))
+            details["devices"][str(device.id)] = {
+                "status": "UP" if up else "DOWN", **stats}
+        details["models"] = {
+            name: {"buckets_compiled": sorted(m.compiled)}
+            for name, m in self._models.items()}
+        details["status"] = "UP" if all_up else "DOWN"
+        return details
+
+    def close(self) -> None:
+        self._models.clear()
+
+
+def new_executor(config, logger, metrics) -> Executor:
+    """Factory (container.go:63-146 composition-root style): mesh shape from
+    env — ``TPU_MESH=dp:2,tp:4`` — else single-mesh over all devices."""
+    mesh = None
+    mesh_env = config.get("TPU_MESH") if config else None
+    if mesh_env:
+        from gofr_tpu.parallel.mesh import make_mesh
+        axes = {}
+        for part in str(mesh_env).split(","):
+            axis, _, size = part.partition(":")
+            axes[axis.strip()] = int(size)
+        mesh = make_mesh(axes)
+    return Executor(logger, metrics, mesh=mesh)

@@ -1,0 +1,135 @@
+"""Model zoo tests (SURVEY.md §4 style: fast, in-process, no hardware)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import bert, llama, resnet
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_llama_forward_shape(llama_setup):
+    cfg, params = llama_setup
+    tokens = jnp.ones((2, 8), jnp.int32)
+    logits = llama.forward(params, cfg, tokens)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_llama_decode_matches_forward(llama_setup):
+    """KV-cache decode must agree with the full causal forward."""
+    cfg, params = llama_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0,
+                                cfg.vocab_size)
+    cache = llama.init_cache(cfg, 2, 32)
+    logits, cache, cache_len = llama.prefill(params, cfg, tokens, cache)
+    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+    step_logits, cache, cache_len = llama.decode_step(
+        params, cfg, nxt, cache, cache_len)
+    full = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    ref = llama.forward(params, cfg, full)[:, -1]
+    np.testing.assert_allclose(np.asarray(step_logits), np.asarray(ref),
+                               atol=0.05)  # bf16 path tolerance
+
+
+def test_llama_prefill_matches_forward_last(llama_setup):
+    cfg, params = llama_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 0,
+                                cfg.vocab_size)
+    cache = llama.init_cache(cfg, 1, 16)
+    logits, _, _ = llama.prefill(params, cfg, tokens, cache)
+    ref = llama.forward(params, cfg, tokens)[:, -1]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=0.05)
+
+
+def test_llama_generate_greedy_deterministic(llama_setup):
+    cfg, params = llama_setup
+    tokens = jnp.ones((1, 4), jnp.int32)
+    out1 = llama.generate(params, cfg, tokens, 6)
+    out2 = llama.generate(params, cfg, tokens, 6)
+    assert out1.shape == (1, 6)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert int(out1.min()) >= 0 and int(out1.max()) < cfg.vocab_size
+
+
+def test_llama_causality(llama_setup):
+    """Changing a future token must not change past logits."""
+    cfg, params = llama_setup
+    tokens = jnp.ones((1, 8), jnp.int32)
+    logits_a = llama.forward(params, cfg, tokens)
+    tokens_b = tokens.at[0, 7].set(5)
+    logits_b = llama.forward(params, cfg, tokens_b)
+    np.testing.assert_allclose(np.asarray(logits_a[:, :7]),
+                               np.asarray(logits_b[:, :7]), atol=1e-5)
+
+
+def test_llama_loss_finite_and_decreasing(llama_setup):
+    cfg, params = llama_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    loss, grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, cfg, tokens, targets))(params)
+    assert bool(jnp.isfinite(loss))
+    norms = jax.tree.map(lambda g: float(jnp.abs(g).max()), grads)
+    assert all(jnp.isfinite(v) for v in jax.tree.leaves(norms))
+
+
+def test_resnet_shapes_and_finite():
+    cfg = resnet.config("tiny")
+    params = resnet.init(cfg, jax.random.PRNGKey(0))
+    images = jax.random.normal(jax.random.PRNGKey(1), (3, 32, 32, 3))
+    logits = resnet.apply(params, cfg, images)
+    assert logits.shape == (3, cfg.num_classes)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_resnet50_geometry():
+    """ResNet-50 param count ≈ 25.5M (sanity that the architecture is real)."""
+    cfg = resnet.config("50")
+    params = jax.eval_shape(lambda k: resnet.init(cfg, k),
+                            jax.random.PRNGKey(0))
+    count = sum(np.prod(l.shape) for l in jax.tree.leaves(params))
+    assert 24e6 < count < 27e6, count
+
+
+def test_bert_outputs():
+    cfg = bert.config("tiny")
+    params = bert.init(cfg, jax.random.PRNGKey(0))
+    ids = jnp.ones((2, 12), jnp.int32)
+    mask = jnp.concatenate([jnp.ones((2, 8), jnp.int32),
+                            jnp.zeros((2, 4), jnp.int32)], axis=1)
+    out = bert.apply(params, cfg, ids, mask)
+    assert out["sequence"].shape == (2, 12, cfg.dim)
+    assert out["pooled"].shape == (2, cfg.dim)
+    assert out["mean"].shape == (2, cfg.dim)
+    assert bool(jnp.isfinite(out["mean"]).all())
+
+
+def test_bert_mask_excludes_padding():
+    """Masked positions must not affect the mean embedding."""
+    cfg = bert.config("tiny")
+    params = bert.init(cfg, jax.random.PRNGKey(0))
+    ids = jnp.ones((1, 8), jnp.int32)
+    mask = jnp.array([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.int32)
+    out_a = bert.apply(params, cfg, ids, mask)
+    ids_b = ids.at[0, 6].set(42)  # change a masked token
+    out_b = bert.apply(params, cfg, ids_b, mask)
+    np.testing.assert_allclose(np.asarray(out_a["mean"]),
+                               np.asarray(out_b["mean"]), atol=1e-5)
+
+
+def test_llama_7b_config_geometry():
+    cfg = llama.config("7b")
+    params = jax.eval_shape(lambda k: llama.init(cfg, k),
+                            jax.random.PRNGKey(0))
+    count = sum(np.prod(l.shape) for l in jax.tree.leaves(params))
+    assert 6.5e9 < count < 7.1e9, count  # Llama-2-7B ≈ 6.74B

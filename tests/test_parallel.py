@@ -1,0 +1,106 @@
+"""Sharding/mesh/ring-attention/train-step tests on the virtual 8-CPU mesh
+(conftest.py forces ``--xla_force_host_platform_device_count=8``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import llama
+from gofr_tpu.ops import attention, prefill_attention
+from gofr_tpu.parallel import (
+    llama_param_specs,
+    make_mesh,
+    make_train_step,
+    ring_attention,
+    serving_mesh,
+    shard_pytree,
+)
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    assert dict(mesh.shape) == {"dp": 2, "tp": 4}
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+    assert dict(serving_mesh(tp=4).shape) == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 16})
+
+
+def test_ring_attention_matches_dense_causal():
+    mesh = make_mesh({"sp": 4})
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 4, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 2, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 2, 16))
+    ref = prefill_attention(q, k, v)
+    out = ring_attention(q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_attention_noncausal_and_dp():
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    q = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 2, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 2, 8))
+    v = jax.random.normal(jax.random.PRNGKey(2), (4, 16, 2, 8))
+    ref = attention(q, k, v)
+    out = ring_attention(q, k, v, mesh, causal=False, batch_axis="dp")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_tp_sharded_forward_matches_single_device():
+    """Tensor-parallel annotation must not change the math."""
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab_size)
+    ref = llama.forward(params, cfg, tokens)
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    sharded = shard_pytree(params, mesh, llama_param_specs())
+    out = jax.jit(lambda p, t: llama.forward(p, cfg, t))(sharded, tokens)
+    # row/column-parallel matmuls change bf16 accumulation order; 0.04 max
+    # deviation observed on tiny preset — assert within 0.1
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.1)
+
+
+def test_train_step_dp_tp_sp_loss_decreases():
+    cfg = llama.config("tiny")
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+    init_fn, step_fn = make_train_step(cfg, mesh, use_sp=True)
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = []
+    for _ in range(3):
+        state, loss = step_fn(state, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 3
+    # params stayed tensor-parallel through the update
+    assert state.params["layers"]["wq"].sharding.spec == \
+        jax.sharding.PartitionSpec(None, None, "tp")
+
+
+def test_train_step_remat():
+    cfg = llama.config("tiny")
+    mesh = make_mesh({"dp": 2})
+    init_fn, step_fn = make_train_step(cfg, mesh, remat=True)
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    state, loss = step_fn(state, tokens, jnp.roll(tokens, -1, axis=1))
+    assert bool(jnp.isfinite(loss))
+
+
+def test_graft_entry_dryrun():
+    """The driver contract: dryrun_multichip compiles + runs on 8 devices."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", "/root/repo/__graft_entry__.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    module.dryrun_multichip(8)
+    fn, args = module.entry()
+    out = jax.eval_shape(fn, *args)  # trace-only: compile check is driver's
+    assert out.shape[-1] == 32000

@@ -1,0 +1,172 @@
+"""Executor + dynamic batcher tests on the CPU backend — the "miniredis of
+XLA" strategy (SURVEY.md §4: the full serve path runs in unit tests without
+hardware, the way GoFr tests pub/sub without a broker)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.tpu import DynamicBatcher, Executor, new_executor
+
+
+def _simple_model():
+    params = {"w": jnp.arange(4, dtype=jnp.float32)}
+
+    def fn(params, x):
+        return x * 2.0 + params["w"]
+
+    return fn, params
+
+
+@pytest.fixture()
+def executor(mock_container):
+    return Executor(mock_container.logger, mock_container.metrics)
+
+
+def test_register_and_predict_pads_to_bucket(executor, mock_container):
+    fn, params = _simple_model()
+    executor.register("double", fn, params, buckets=(2, 4))
+    x = np.ones((3, 4), np.float32)
+    out = executor.predict("double", x)
+    assert out.shape == (3, 4)  # padded to 4, sliced back to 3
+    np.testing.assert_allclose(out, x * 2 + np.arange(4))
+    # bucket 4 compiled, bucket 2 not
+    assert sorted(executor._models["double"].compiled) == [4]
+    assert mock_container.metrics.value(
+        "app_tpu_requests_total", model="double") == 1.0
+
+
+def test_predict_splits_oversized_batch(executor):
+    fn, params = _simple_model()
+    executor.register("double", fn, params, buckets=(1, 2))
+    x = np.ones((5, 4), np.float32)
+    out = executor.predict("double", x)
+    assert out.shape == (5, 4)
+    np.testing.assert_allclose(out, x * 2 + np.arange(4))
+
+
+def test_predict_unknown_model_raises(executor):
+    with pytest.raises(KeyError):
+        executor.predict("nope", np.ones((1, 2)))
+
+
+def test_warmup_compiles_all_buckets(executor):
+    fn, params = _simple_model()
+    executor.register("double", fn, params, buckets=(1, 2, 4))
+    executor.warmup("double", np.ones((4,), np.float32))
+    assert sorted(executor._models["double"].compiled) == [1, 2, 4]
+
+
+def test_multi_input_pytree(executor):
+    params = {}
+
+    def fn(params, inputs):
+        ids, mask = inputs
+        return ids.sum(-1) + mask.sum(-1)
+
+    executor.register("pair", fn, params, buckets=(2,))
+    out = executor.predict(
+        "pair", (np.ones((2, 3), np.int32), np.ones((2, 3), np.int32)))
+    np.testing.assert_allclose(out, [6, 6])
+
+
+def test_health_check_reports_devices(executor):
+    fn, params = _simple_model()
+    executor.register("double", fn, params, buckets=(1,))
+    health = executor.health_check()
+    assert health["status"] == "UP"
+    assert len(health["devices"]) == len(jax.devices())
+    assert health["models"]["double"]["buckets_compiled"] == []
+
+
+def test_new_executor_mesh_from_env(mock_container):
+    from gofr_tpu.config import MapConfig
+    executor = new_executor(MapConfig({"TPU_MESH": "dp:2,tp:4"}),
+                            mock_container.logger, mock_container.metrics)
+    assert dict(executor.mesh.shape) == {"dp": 2, "tp": 4}
+
+
+def test_data_parallel_predict_over_mesh(mock_container):
+    from gofr_tpu.parallel import make_mesh
+    mesh = make_mesh({"dp": 8})
+    executor = Executor(mock_container.logger, mock_container.metrics,
+                        mesh=mesh)
+    fn, params = _simple_model()
+    executor.register("double", fn, params, buckets=(8,))
+    out = executor.predict("double", np.ones((8, 4), np.float32))
+    np.testing.assert_allclose(out, np.ones((8, 4)) * 2 + np.arange(4))
+
+
+def test_dynamic_batcher_coalesces(mock_container):
+    executor = Executor(mock_container.logger, mock_container.metrics)
+    calls = []
+
+    def fn(params, x):
+        return x * 2.0
+
+    executor.register("m", fn, {}, buckets=(1, 2, 4, 8))
+    real_predict = executor.predict
+
+    def spying_predict(name, batch):
+        calls.append(jax.tree.leaves(batch)[0].shape[0])
+        return real_predict(name, batch)
+
+    executor.predict = spying_predict
+    batcher = DynamicBatcher(executor, max_batch=8, max_delay_ms=20.0,
+                             logger=mock_container.logger)
+
+    async def scenario():
+        results = await asyncio.gather(
+            *[batcher.predict("m", np.full((3,), float(i)))
+              for i in range(5)])
+        return results
+
+    results = asyncio.run(scenario())
+    for i, out in enumerate(results):
+        np.testing.assert_allclose(out, np.full((3,), 2.0 * i))
+    # all 5 coalesced into one device call (well under the 20ms window)
+    assert calls == [5]
+
+
+def test_dynamic_batcher_flushes_at_max_batch(mock_container):
+    executor = Executor(mock_container.logger, mock_container.metrics)
+
+    def fn(params, x):
+        return x + 1.0
+
+    executor.register("m", fn, {}, buckets=(2,))
+    batcher = DynamicBatcher(executor, max_batch=2, max_delay_ms=10_000.0)
+
+    async def scenario():
+        return await asyncio.gather(
+            batcher.predict("m", np.zeros((2,))),
+            batcher.predict("m", np.ones((2,))))
+
+    a, b = asyncio.run(scenario())  # would hang if max_batch didn't flush
+    np.testing.assert_allclose(a, [1.0, 1.0])
+    np.testing.assert_allclose(b, [2.0, 2.0])
+
+
+def test_dynamic_batcher_propagates_errors(mock_container):
+    executor = Executor(mock_container.logger, mock_container.metrics)
+    batcher = DynamicBatcher(executor, max_batch=4, max_delay_ms=1.0,
+                             logger=mock_container.logger)
+
+    async def scenario():
+        with pytest.raises(KeyError):
+            await batcher.predict("unregistered", np.zeros((1,)))
+
+    asyncio.run(scenario())
+
+
+def test_container_wires_tpu_executor():
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.container import Container
+    container = Container.create(MapConfig({"TPU_ENABLED": "true"}))
+    assert container.tpu is not None
+    health = container.health()
+    assert "tpu" in health
