@@ -190,6 +190,16 @@ class App:
         self._cli_commands.append(
             CLICommand(pattern, handler, description, help_text))
 
+    # -- outbound services (gofr.go AddHTTPService) -------------------------
+    def add_http_service(self, name: str, base_url: str, *options,
+                         timeout: float = 30.0) -> None:
+        from gofr_tpu.service import new_http_service
+        service = new_http_service(
+            base_url, self.logger, self.container.metrics,
+            self.container.tracer, *options, timeout=timeout,
+            service_name=name)
+        self.container.add_http_service(name, service)
+
     # -- TPU model registration (north star) --------------------------------
     def add_model(self, name: str, model, **kwargs) -> None:
         """Register a servable model with the container's TPU executor."""

@@ -1,0 +1,7 @@
+"""CLI transport (parity: pkg/gofr/cmd, SURVEY.md §2.1 CLI runner)."""
+
+from gofr_tpu.cli.command import CLICommand, CLIRequest, CLIResponder
+from gofr_tpu.cli.runner import print_help, run_cli
+
+__all__ = ["CLICommand", "CLIRequest", "CLIResponder", "print_help",
+           "run_cli"]

@@ -1,0 +1,90 @@
+"""CLI transport: command registry, request parsing, stdout responder.
+
+Capability parity with ``pkg/gofr/cmd`` (cmd.go:92-107 regex route table;
+cmd/request.go:14-67 flag parsing ``-a=b`` / ``--flag``; cmd/responder.go
+stdout/stderr; cmd.go:110-151 AddDescription/AddHelp + help printer).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+
+class CLICommand:
+    def __init__(self, pattern: str, handler, description: str = "",
+                 help_text: str = ""):
+        self.pattern = pattern
+        self.regex = re.compile("^" + pattern + "$")
+        self.handler = handler
+        self.description = description
+        self.help_text = help_text
+
+
+class CLIRequest:
+    """Transport-agnostic Request over os.Args (cmd/request.go:25-67):
+    ``-key=value`` / ``--key=value`` → params; bare ``--flag`` → "true";
+    positional words are the subcommand."""
+
+    def __init__(self, argv: List[str]):
+        self.argv = list(argv)
+        self._params: Dict[str, str] = {}
+        self.words: List[str] = []
+        for token in argv:
+            if token.startswith("-"):
+                stripped = token.lstrip("-")
+                key, eq, value = stripped.partition("=")
+                if not key:
+                    continue
+                self._params[key] = value if eq else "true"
+            else:
+                self.words.append(token)
+        self.subcommand = " ".join(self.words)
+
+    # Request interface (request.go:10-16)
+    def param(self, key: str) -> str:
+        return self._params.get(key, "")
+
+    def params(self, key: str) -> List[str]:
+        value = self._params.get(key)
+        return value.split(",") if value else []
+
+    def path_param(self, key: str) -> str:
+        return self.param(key)
+
+    def bind(self, target: Any = None) -> Any:
+        return dict(self._params) if target is None else target(
+            **self._params)
+
+    def header(self, key: str) -> str:
+        return ""
+
+    @property
+    def method(self) -> str:
+        return "CLI"
+
+    @property
+    def path(self) -> str:
+        return self.subcommand
+
+
+class CLIResponder:
+    """Result → stdout, error → stderr (cmd/responder.go:10-19)."""
+
+    def __init__(self, stdout=None, stderr=None):
+        import sys
+        self.stdout = stdout or sys.stdout
+        self.stderr = stderr or sys.stderr
+
+    def respond(self, result: Any, error: Optional[Exception]) -> int:
+        if error is not None:
+            print(str(error) or repr(error), file=self.stderr)
+            return 1
+        if result is not None:
+            if isinstance(result, (dict, list)):
+                import json
+                print(json.dumps(result, indent=2, default=str),
+                      file=self.stdout)
+            else:
+                print(result, file=self.stdout)
+        return 0

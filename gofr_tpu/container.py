@@ -181,8 +181,14 @@ def new_mock_container(config: Optional[Dict[str, str]] = None) -> Container:
     container = Container(config=MapConfig(config or {}),
                          logger=new_silent_logger())
     container.register_framework_metrics()
-    from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
     from gofr_tpu.datasource.file import LocalFileSystem
+    from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
+    from gofr_tpu.datasource.redisx import InMemoryRedis
+    from gofr_tpu.datasource.sql import new_sql
     container.pubsub = InMemoryBroker(container.logger, container.metrics)
     container.file = LocalFileSystem(container.logger)
+    container.redis = InMemoryRedis(container.logger, container.metrics)
+    container.sql = new_sql(MapConfig({"DB_DIALECT": "sqlite",
+                                       "DB_NAME": ":memory:"}),
+                            container.logger, container.metrics)
     return container

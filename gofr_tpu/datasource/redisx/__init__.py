@@ -1,0 +1,10 @@
+"""Redis datasource (parity: pkg/gofr/datasource/redis, SURVEY.md §2.4)."""
+
+from gofr_tpu.datasource.redisx.client import (
+    InMemoryRedis,
+    RedisClient,
+    RedisError,
+    new_redis,
+)
+
+__all__ = ["InMemoryRedis", "RedisClient", "RedisError", "new_redis"]

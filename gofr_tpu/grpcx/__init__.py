@@ -1,0 +1,5 @@
+"""gRPC transport (parity: pkg/gofr/grpc, SURVEY.md §2.1)."""
+
+from gofr_tpu.grpcx.server import GRPCRequest, GRPCServer
+
+__all__ = ["GRPCRequest", "GRPCServer"]

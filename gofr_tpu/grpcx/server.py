@@ -1,0 +1,173 @@
+"""gRPC transport on grpc.aio, sharing the app's event loop.
+
+Capability parity with ``pkg/gofr/grpc`` + gofr.go:55-59 RegisterService
+(newGRPCServer grpc.go:20-29 chains recovery + LoggingInterceptor; Run
+31-46). Two registration styles:
+
+- protoc: ``app.register_grpc_service(add_FooServicer_to_server, Foo())``
+- dynamic JSON unary (original to this framework): no protoc needed —
+  ``app.register_grpc_unary("Predict", "classify", handler)`` exposes
+  ``/gofr.Predict/classify`` taking/returning JSON bytes, and the handler
+  receives a normal gofr Context. This is the BERT/Llama streaming serve
+  surface (BASELINE.md config 3) without codegen in the loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import grpc
+
+from gofr_tpu.context import Context
+
+
+class GRPCRequest:
+    """Transport-agnostic Request over a JSON unary payload."""
+
+    def __init__(self, payload: Any, service: str, method: str,
+                 metadata: Dict[str, str]):
+        self.payload = payload if isinstance(payload, dict) else {}
+        self._raw = payload
+        self.service = service
+        self.method_name = method
+        self.metadata = metadata
+
+    def param(self, key: str) -> str:
+        value = self.payload.get(key, "")
+        return "" if value is None else str(value)
+
+    def params(self, key: str) -> List[str]:
+        value = self.payload.get(key)
+        if isinstance(value, list):
+            return [str(v) for v in value]
+        return [str(value)] if value is not None else []
+
+    def path_param(self, key: str) -> str:
+        return self.param(key)
+
+    def bind(self, target: Any = None) -> Any:
+        if target is None:
+            return self._raw
+        if isinstance(self._raw, dict):
+            return target(**self._raw)
+        return self._raw
+
+    def header(self, key: str) -> str:
+        return self.metadata.get(key.lower(), "")
+
+    @property
+    def method(self) -> str:
+        return "GRPC"
+
+    @property
+    def path(self) -> str:
+        return f"/{self.service}/{self.method_name}"
+
+
+class _LoggingInterceptor(grpc.aio.ServerInterceptor):
+    """Per-RPC log + latency (parity: grpc/log.go:59 LoggingInterceptor)."""
+
+    def __init__(self, logger, metrics):
+        self.logger = logger
+        self.metrics = metrics
+
+    async def intercept_service(self, continuation, handler_call_details):
+        handler = await continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        inner = handler.unary_unary
+        method = handler_call_details.method
+        logger, metrics = self.logger, self.metrics
+
+        async def wrapper(request, context):
+            start = time.perf_counter()
+            try:
+                response = await inner(request, context)
+                elapsed = time.perf_counter() - start
+                logger.info("gRPC %s ok in %.2fms", method, elapsed * 1e3)
+                metrics.record_histogram("app_http_service_response",
+                                         elapsed, service="grpc",
+                                         method=method, status="OK")
+                return response
+            except Exception as exc:
+                logger.error("gRPC %s failed: %r", method, exc)
+                raise
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapper, request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer)
+
+
+class GRPCServer:
+    def __init__(self, container, port: int, logger=None,
+                 host: str = "0.0.0.0"):
+        self.container = container
+        self.port = port
+        self.host = host
+        self.logger = logger or container.logger
+        self._dynamic: Dict[str, Dict[str, Callable]] = {}
+        self._protoc: List[Tuple[Callable, Any]] = []
+        self._server: Optional[grpc.aio.Server] = None
+        self.bound_port: int = port
+
+    def register(self, spec, servicer) -> None:
+        if isinstance(spec, tuple) and spec and spec[0] == "dynamic":
+            _, service, method = spec
+            self._dynamic.setdefault(service, {})[method] = servicer
+        else:
+            self._protoc.append((spec, servicer))
+
+    def _dynamic_handler(self, service: str,
+                         methods: Dict[str, Callable]):
+        container = self.container
+
+        def make(method_name: str, handler: Callable):
+            async def unary(request_bytes: bytes, context) -> bytes:
+                try:
+                    payload = json.loads(request_bytes or b"null")
+                except json.JSONDecodeError:
+                    await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                        "body is not valid JSON")
+                metadata = {k: v for k, v in
+                            (context.invocation_metadata() or [])}
+                ctx = Context(GRPCRequest(payload, service, method_name,
+                                          metadata), container)
+                try:
+                    result = handler(ctx)
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                except Exception as exc:  # panic isolation (grpc.go:23-25)
+                    container.logger.error("gRPC handler panic: %r", exc)
+                    await context.abort(grpc.StatusCode.INTERNAL, str(exc))
+                from gofr_tpu.http.responder import _jsonable
+                return json.dumps({"data": _jsonable(result)},
+                                  default=str).encode()
+
+            return grpc.unary_unary_rpc_method_handler(unary)
+
+        handlers = {name: make(name, fn) for name, fn in methods.items()}
+        return grpc.method_handlers_generic_handler(f"gofr.{service}",
+                                                    handlers)
+
+    async def start(self) -> None:
+        self._server = grpc.aio.server(
+            interceptors=[_LoggingInterceptor(self.logger,
+                                              self.container.metrics)])
+        for register_fn, servicer in self._protoc:
+            register_fn(servicer, self._server)
+        for service, methods in self._dynamic.items():
+            self._server.add_generic_rpc_handlers(
+                (self._dynamic_handler(service, methods),))
+        self.bound_port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        await self._server.start()
+        self.logger.info("gRPC server listening on %s:%d", self.host,
+                         self.bound_port)
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None

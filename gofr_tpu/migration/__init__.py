@@ -1,0 +1,12 @@
+"""Migrations (parity: pkg/gofr/migration, SURVEY.md §2.6)."""
+
+from gofr_tpu.migration.runner import (
+    Datasources,
+    Migration,
+    MigrationError,
+    last_migration,
+    run_migrations,
+)
+
+__all__ = ["Datasources", "Migration", "MigrationError", "last_migration",
+           "run_migrations"]

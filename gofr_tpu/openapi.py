@@ -1,0 +1,64 @@
+"""OpenAPI serving: spec + self-contained doc UI.
+
+Capability parity with ``pkg/gofr/swagger.go`` (OpenAPIHandler serves
+./static/openapi.json 22-33; SwaggerUIHandler 36-55 serves an embedded UI;
+wired under /.well-known/* when the file exists, gofr.go:137-141). The
+reference embeds the swagger-ui bundle; this image is zero-egress, so the
+UI is an original single-file renderer (vanilla JS over the spec JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_UI_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>API docs</title><style>
+body{font-family:system-ui,sans-serif;margin:2rem auto;max-width:60rem;
+padding:0 1rem;color:#1a1a1a}h1{font-size:1.6rem}
+.op{border:1px solid #ddd;border-radius:6px;margin:.7rem 0;padding:.7rem}
+.m{display:inline-block;font-weight:700;border-radius:4px;padding:.1rem .5rem;
+color:#fff;margin-right:.6rem;font-size:.85rem}
+.GET{background:#2f6f44}.POST{background:#9a5b13}.PUT{background:#31589c}
+.PATCH{background:#6b4a9c}.DELETE{background:#9c3131}
+code{background:#f4f4f4;padding:.1rem .3rem;border-radius:3px}
+pre{background:#f7f7f7;padding:.6rem;border-radius:4px;overflow:auto}
+.desc{color:#555;margin:.3rem 0 0}</style></head><body>
+<h1 id="title">API documentation</h1><p id="version"></p><div id="ops"></div>
+<script>
+fetch('openapi.json').then(r=>r.json()).then(spec=>{
+  document.getElementById('title').textContent=(spec.info&&spec.info.title)||'API';
+  document.getElementById('version').textContent=(spec.info&&spec.info.version)||'';
+  const ops=document.getElementById('ops');
+  for(const [path,methods] of Object.entries(spec.paths||{})){
+    for(const [method,op] of Object.entries(methods)){
+      const div=document.createElement('div');div.className='op';
+      const M=method.toUpperCase();
+      div.innerHTML=`<span class="m ${M}">${M}</span><code>${path}</code>`+
+        `<p class="desc">${(op&&(op.summary||op.description))||''}</p>`+
+        (op&&op.parameters?`<pre>${JSON.stringify(op.parameters,null,2)}</pre>`:'');
+      ops.appendChild(div);
+    }
+  }
+});
+</script></body></html>"""
+
+
+def make_openapi_handlers(spec_path: str):
+    """(spec_handler, ui_handler) wire pair for /.well-known routes."""
+
+    async def spec_handler(request):
+        try:
+            with open(spec_path, "rb") as handle:
+                body = handle.read()
+            json.loads(body)  # refuse to serve a broken spec
+        except Exception:
+            return 500, {"Content-Type": "application/json"}, \
+                b'{"error":"openapi.json missing or invalid"}'
+        return 200, {"Content-Type": "application/json"}, body
+
+    async def ui_handler(request):
+        return 200, {"Content-Type": "text/html; charset=utf-8"}, \
+            _UI_HTML.encode()
+
+    return spec_handler, ui_handler

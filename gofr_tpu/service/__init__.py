@@ -1,0 +1,28 @@
+"""Outbound HTTP client with decorator options: auth, default headers,
+circuit breaker, health override (parity: pkg/gofr/service, SURVEY.md §2.5)."""
+
+from gofr_tpu.service.circuit_breaker import (
+    CircuitBreakerConfig,
+    CircuitOpenError,
+)
+from gofr_tpu.service.client import (
+    HTTPService,
+    ServiceError,
+    ServiceResponse,
+)
+from gofr_tpu.service.options import (
+    APIKeyConfig,
+    BasicAuthConfig,
+    DefaultHeaders,
+    HealthConfig,
+    OAuthConfig,
+    Option,
+    new_http_service,
+)
+
+__all__ = [
+    "APIKeyConfig", "BasicAuthConfig", "CircuitBreakerConfig",
+    "CircuitOpenError", "DefaultHeaders", "HealthConfig", "HTTPService",
+    "OAuthConfig", "Option", "ServiceError", "ServiceResponse",
+    "new_http_service",
+]

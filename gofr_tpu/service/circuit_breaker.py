@@ -1,0 +1,102 @@
+"""Circuit breaker for the outbound client.
+
+Capability parity with ``pkg/gofr/service/circuit_breaker.go``
+(CircuitBreakerConfig{Threshold,Interval} 24-27; closed/open states 12-15;
+executeWithCircuitBreaker 59-90; background health ticker that closes the
+circuit when the health endpoint answers 101-120; wraps all verbs 216-271).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from gofr_tpu.service.client import HTTPService, ServiceError
+from gofr_tpu.service.options import Option
+
+
+class CircuitOpenError(ServiceError):
+    """Fast-fail while the circuit is open."""
+
+
+class CircuitBreakerConfig(Option):
+    def __init__(self, threshold: int = 5, interval: float = 10.0):
+        self.threshold = threshold
+        self.interval = interval
+
+    def add_option(self, service: HTTPService) -> HTTPService:
+        return _CircuitBreakerService(service, self.threshold, self.interval)
+
+
+class _CircuitBreakerService(HTTPService):
+    def __init__(self, inner: HTTPService, threshold: int, interval: float):
+        self.__dict__.update(inner.__dict__)
+        self._inner = inner
+        self._threshold = threshold
+        self._interval = interval
+        self._failures = 0
+        self._open = False
+        self._lock = threading.Lock()
+        self._probe: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+    def request(self, method, path, params=None, body=None, headers=None):
+        with self._lock:
+            if self._open:
+                raise CircuitOpenError(
+                    f"circuit open for {self.service_name}")
+        try:
+            response = self._inner.request(method, path, params=params,
+                                           body=body, headers=headers)
+        except ServiceError:
+            self._on_failure()
+            raise
+        if response.status_code >= 500:
+            self._on_failure()
+        else:
+            with self._lock:
+                self._failures = 0
+        return response
+
+    def _on_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._failures >= self._threshold and not self._open:
+                self._open = True
+                if self.logger is not None:
+                    self.logger.warn("circuit OPEN for %s after %d failures",
+                                     self.service_name, self._failures)
+                self._start_probe()
+
+    # -- recovery probe (circuit_breaker.go:101-120) ------------------------
+    def _start_probe(self) -> None:
+        self._stop.clear()
+        self._probe = threading.Thread(target=self._probe_loop, daemon=True,
+                                       name=f"cb-probe-{self.service_name}")
+        self._probe.start()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            health = self._inner.health_check()
+            if health.get("status") == "UP":
+                with self._lock:
+                    self._open = False
+                    self._failures = 0
+                if self.logger is not None:
+                    self.logger.info("circuit CLOSED for %s (health probe ok)",
+                                     self.service_name)
+                return
+
+    def health_check(self):
+        health = self._inner.health_check()
+        health.setdefault("details", {})["circuit"] = (
+            "open" if self._open else "closed")
+        return health
+
+    def close(self) -> None:
+        self._stop.set()

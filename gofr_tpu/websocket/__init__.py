@@ -1,0 +1,12 @@
+"""WebSocket transport (parity: pkg/gofr/websocket + ws middleware)."""
+
+from gofr_tpu.websocket.connection import (
+    Connection,
+    ConnectionClosed,
+    ConnectionHub,
+)
+from gofr_tpu.websocket.frames import accept_key, decode_frame, encode_frame
+from gofr_tpu.websocket.upgrade import hub, make_ws_route
+
+__all__ = ["Connection", "ConnectionClosed", "ConnectionHub", "accept_key",
+           "decode_frame", "encode_frame", "hub", "make_ws_route"]

@@ -1,0 +1,68 @@
+"""WebSocket upgrade route: handshake + connection loop.
+
+Capability parity with ``pkg/gofr/http/middleware/web_socket.go`` (upgrade
+when requested, store conn in hub keyed by Sec-WebSocket-Key 14-37) and
+``pkg/gofr/gofr/websocket.go`` (App.WebSocket swaps ctx.Request for the
+Connection 18-35; read-eval-write handled by the user handler).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import urllib.parse
+
+from gofr_tpu.context import Context
+from gofr_tpu.websocket.connection import Connection, ConnectionClosed, ConnectionHub
+from gofr_tpu.websocket.frames import accept_key
+
+_hub = ConnectionHub()
+
+
+def hub() -> ConnectionHub:
+    return _hub
+
+
+def make_ws_route(handler, container):
+    """Build the wire handler for a websocket route. Returns 101 + an
+    ``upgrade_protocol`` continuation the HTTP server runs after switching
+    protocols (http/server.py serve loop)."""
+
+    async def ws_wire_handler(request):
+        if request.headers.get("upgrade", "").lower() != "websocket":
+            return 426, {"Content-Type": "text/plain"}, b"upgrade required"
+        key = request.headers.get("sec-websocket-key", "")
+        if not key:
+            return 400, {}, b"missing Sec-WebSocket-Key"
+
+        query = urllib.parse.parse_qs(request.query or "")
+
+        async def run_connection(transport, set_feed):
+            connection = Connection(transport, key, request.path,
+                                    path_params=dict(request.path_params),
+                                    query_params=query)
+            leftover = set_feed(connection.feed)
+            if leftover:
+                connection.feed(leftover)
+            _hub.add(connection)
+            ctx = Context(connection, container)
+            try:
+                result = handler(ctx)
+                if asyncio.iscoroutine(result):
+                    await result
+            except ConnectionClosed:
+                pass
+            except Exception as exc:
+                container.logger.error("websocket handler panic: %r", exc)
+            finally:
+                _hub.remove(key)
+                connection.close()
+                set_feed(None)
+
+        request.context_values["upgrade_protocol"] = run_connection
+        return 101, {
+            "Upgrade": "websocket",
+            "Connection": "Upgrade",
+            "Sec-WebSocket-Accept": accept_key(key),
+        }, b""
+
+    return ws_wire_handler

@@ -1,0 +1,278 @@
+"""SQL / Redis / migration / service-client / CRUD tests (SURVEY.md §4:
+fake backends in-process — sqlite :memory:, miniredis, httptest-style local
+server)."""
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.datasource.redisx import InMemoryRedis
+from gofr_tpu.datasource.sql import new_sql
+from gofr_tpu.migration import Migration, MigrationError, last_migration, run_migrations
+from gofr_tpu.service import (
+    APIKeyConfig,
+    BasicAuthConfig,
+    CircuitBreakerConfig,
+    CircuitOpenError,
+    DefaultHeaders,
+    new_http_service,
+)
+
+
+# -- SQL ---------------------------------------------------------------------
+
+@pytest.fixture()
+def db(mock_container):
+    return mock_container.sql
+
+
+def test_sql_exec_select_roundtrip(db):
+    db.execute("CREATE TABLE users (id INTEGER PRIMARY KEY, name TEXT)")
+    assert db.execute("INSERT INTO users (id, name) VALUES (?, ?)",
+                      1, "ada") == 1
+    rows = db.select("SELECT * FROM users")
+    assert rows == [{"id": 1, "name": "ada"}]
+    assert db.query_row("SELECT name FROM users WHERE id = ?",
+                        1) == {"name": "ada"}
+
+
+def test_sql_bind_dataclass(db):
+    @dataclasses.dataclass
+    class User:
+        id: int
+        name: str
+
+    db.execute("CREATE TABLE users (id INTEGER PRIMARY KEY, name TEXT)")
+    db.execute("INSERT INTO users VALUES (?, ?)", 2, "grace")
+    users = db.bind(User, "SELECT * FROM users")
+    assert users == [User(id=2, name="grace")]
+
+
+def test_sql_transaction_rollback(db):
+    db.execute("CREATE TABLE t (x INTEGER)")
+    tx = db.begin()
+    tx.execute("INSERT INTO t VALUES (1)")
+    tx.rollback()
+    assert db.select("SELECT * FROM t") == []
+    with db.begin() as tx:
+        tx.execute("INSERT INTO t VALUES (2)")
+    assert db.select("SELECT * FROM t") == [{"x": 2}]
+
+
+def test_sql_health(db):
+    assert db.health_check()["status"] == "UP"
+
+
+def test_sql_metrics_recorded(mock_container):
+    db = mock_container.sql
+    db.execute("CREATE TABLE m (x INTEGER)")
+    db.select("SELECT * FROM m")
+    snapshot = mock_container.metrics.snapshot()
+    assert "app_sql_stats" in snapshot
+
+
+# -- Redis -------------------------------------------------------------------
+
+@pytest.fixture()
+def redis(mock_container):
+    return mock_container.redis
+
+
+def test_redis_get_set_delete(redis):
+    assert redis.get("k") is None
+    assert redis.set("k", "v")
+    assert redis.get("k") == "v"
+    assert redis.delete("k") == 1
+    assert redis.exists("k") == 0
+
+
+def test_redis_ttl_expiry(redis):
+    redis.set("tmp", "x", ttl_seconds=0.01)
+    assert redis.get("tmp") == "x"
+    import time
+    time.sleep(0.03)
+    assert redis.get("tmp") is None
+
+
+def test_redis_counters_and_hashes(redis):
+    assert redis.incr("n") == 1
+    assert redis.incr("n") == 2
+    assert redis.decr("n") == 1
+    assert redis.hset("h", "a", "1") == 1
+    assert redis.hget("h", "a") == "1"
+    assert redis.hgetall("h") == {"a": "1"}
+    assert redis.hsetnx("h", "a", "2") is False
+    assert redis.hsetnx("h", "b", "2") is True
+
+
+def test_redis_lists_and_keys(redis):
+    redis.rpush("l", "a", "b")
+    redis.lpush("l", "z")
+    assert redis.llen("l") == 3
+    assert redis.lpop("l") == "z"
+    assert redis.rpop("l") == "b"
+    redis.set("user:1", "x")
+    redis.set("user:2", "y")
+    assert sorted(redis.keys("user:*")) == ["user:1", "user:2"]
+
+
+def test_redis_health(redis):
+    health = redis.health_check()
+    assert health["status"] == "UP"
+    assert health["details"]["engine"] == "memory"
+
+
+def test_new_redis_memory_engine():
+    container = new_mock_container()
+    from gofr_tpu.datasource.redisx import new_redis
+    client = new_redis(MapConfig({"REDIS_HOST": "memory"}),
+                       container.logger, container.metrics)
+    assert isinstance(client, InMemoryRedis)
+
+
+# -- migrations --------------------------------------------------------------
+
+def test_migrations_run_in_order_and_journal(mock_container):
+    order = []
+
+    def make(tag, ddl):
+        def up(ds):
+            order.append(tag)
+            ds.sql.execute(ddl)
+        return Migration(up=up)
+
+    migrations = {
+        2: make("second", "CREATE TABLE b (x INTEGER)"),
+        1: make("first", "CREATE TABLE a (x INTEGER)"),
+    }
+    assert run_migrations(mock_container, migrations) == 2
+    assert order == ["first", "second"]
+    assert last_migration(mock_container) == 2
+    # idempotent: re-run skips both
+    assert run_migrations(mock_container, migrations) == 0
+
+
+def test_migration_rollback_on_failure(mock_container):
+    def bad(ds):
+        ds.sql.execute("CREATE TABLE c (x INTEGER)")
+        raise RuntimeError("boom")
+
+    with pytest.raises(MigrationError):
+        run_migrations(mock_container, {1: Migration(up=bad)})
+    # rolled back: table c must not exist
+    from gofr_tpu.datasource.sql import SQLError
+    with pytest.raises(SQLError):
+        mock_container.sql.select("SELECT * FROM c")
+    assert last_migration(mock_container) == 0
+
+
+def test_migration_invalid_version(mock_container):
+    with pytest.raises(MigrationError):
+        run_migrations(mock_container, {0: Migration(up=lambda ds: None)})
+
+
+# -- outbound HTTP client ----------------------------------------------------
+
+class _Upstream(BaseHTTPRequestHandler):
+    fail = False
+
+    def _serve(self):
+        if _Upstream.fail and self.path != "/.well-known/alive":
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(b"{}")
+            return
+        body = json.dumps({
+            "path": self.path,
+            "headers": {k.lower(): v for k, v in self.headers.items()},
+            "method": self.command,
+        }).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _serve
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def upstream():
+    _Upstream.fail = False
+    server = HTTPServer(("127.0.0.1", 0), _Upstream)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_service_verbs_and_params(mock_container, upstream):
+    service = new_http_service(upstream, mock_container.logger,
+                               mock_container.metrics,
+                               service_name="up")
+    data = service.get("echo", params={"q": "1"}).json()
+    assert data["path"] == "/echo?q=1"
+    assert data["method"] == "GET"
+    assert service.post("x", body={"a": 1}).json()["method"] == "POST"
+    assert service.put("x").json()["method"] == "PUT"
+    assert service.patch("x").json()["method"] == "PATCH"
+    assert service.delete("x").json()["method"] == "DELETE"
+    # histogram recorded
+    assert "app_http_service_response" in mock_container.metrics.snapshot()
+
+
+def test_service_auth_decorators(mock_container, upstream):
+    service = new_http_service(
+        upstream, mock_container.logger, mock_container.metrics, None,
+        APIKeyConfig("sekret"), DefaultHeaders({"X-Team": "tpu"}))
+    headers = service.get("h").json()["headers"]
+    assert headers["x-api-key"] == "sekret"
+    assert headers["x-team"] == "tpu"
+
+    basic = new_http_service(
+        upstream, None, None, None, BasicAuthConfig("user", "pass"))
+    auth = basic.get("h").json()["headers"]["authorization"]
+    import base64
+    assert auth == "Basic " + base64.b64encode(b"user:pass").decode()
+
+
+def test_service_traceparent_injected(mock_container, upstream):
+    service = new_http_service(upstream, mock_container.logger,
+                               mock_container.metrics,
+                               mock_container.tracer)
+    headers = service.get("t").json()["headers"]
+    assert "traceparent" in headers
+
+
+def test_circuit_breaker_opens_and_recovers(mock_container, upstream):
+    service = new_http_service(
+        upstream, mock_container.logger, mock_container.metrics, None,
+        CircuitBreakerConfig(threshold=2, interval=0.05))
+    _Upstream.fail = True
+    assert service.get("a").status_code == 500
+    assert service.get("a").status_code == 500  # threshold hit → open
+    with pytest.raises(CircuitOpenError):
+        service.get("a")
+    # health endpoint answers → probe closes the circuit
+    _Upstream.fail = False
+    import time
+    deadline = time.time() + 2.0
+    while time.time() < deadline and service.is_open:
+        time.sleep(0.02)
+    assert not service.is_open
+    assert service.get("a").status_code == 200
+
+
+def test_service_health_check(mock_container, upstream):
+    service = new_http_service(upstream, None, None, None)
+    assert service.health_check()["status"] == "UP"
+    bad = new_http_service("http://127.0.0.1:1", None, None, None,
+                           timeout=0.2)
+    assert bad.health_check()["status"] == "DOWN"

@@ -1,0 +1,269 @@
+"""CLI / CRUD / WebSocket / gRPC / OpenAPI transport tests."""
+
+import asyncio
+import contextlib
+import dataclasses
+import io
+import json
+
+import pytest
+
+from tests.util import http_request, make_app, run, serving
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _cli_app():
+    from gofr_tpu.app import App
+    from gofr_tpu.container import new_mock_container
+    container = new_mock_container()
+    app = App(config=container.config, container=container)
+    return app
+
+
+def test_cli_command_dispatch_and_params():
+    from gofr_tpu.cli import run_cli
+    app = _cli_app()
+    seen = {}
+
+    def hello(ctx):
+        seen["name"] = ctx.param("name")
+        return f"Hello {ctx.param('name')}!"
+
+    app.sub_command("hello", hello, description="greets")
+    out = io.StringIO()
+    code = run_cli(app, ["hello", "-name=ada"], stdout=out)
+    assert code == 0
+    assert out.getvalue().strip() == "Hello ada!"
+    assert seen["name"] == "ada"
+
+
+def test_cli_regex_route_and_unknown():
+    from gofr_tpu.cli import run_cli
+    app = _cli_app()
+    app.sub_command("log [a-z]+", lambda ctx: "ok")
+    out, err = io.StringIO(), io.StringIO()
+    assert run_cli(app, ["log", "info"], stdout=out, stderr=err) == 0
+    assert run_cli(app, ["nope"], stdout=out, stderr=err) == 2
+    assert "unknown command" in err.getvalue()
+
+
+def test_cli_help_and_error_exit_code():
+    from gofr_tpu.cli import run_cli
+    app = _cli_app()
+    app.sub_command("boom", lambda ctx: 1 / 0, description="explodes")
+    out, err = io.StringIO(), io.StringIO()
+    assert run_cli(app, ["--help"], stdout=out, stderr=err) == 0
+    assert "boom" in out.getvalue()
+    assert run_cli(app, ["boom"], stdout=out, stderr=err) == 1
+
+
+# -- CRUD scaffolding --------------------------------------------------------
+
+@dataclasses.dataclass
+class Book:
+    isbn: int = 0
+    title: str = ""
+    author: str = ""
+
+
+def test_crud_end_to_end():
+    async def main():
+        app = make_app()
+        app.container.sql.execute(
+            "CREATE TABLE book (isbn INTEGER PRIMARY KEY, title TEXT, "
+            "author TEXT)")
+        app.add_rest_handlers(Book)
+        async with serving(app) as port:
+            created = await http_request(
+                port, "POST", "/book",
+                body=json.dumps({"isbn": 1, "title": "SICP",
+                                 "author": "abelson"}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert created.status == 201
+
+            everything = await http_request(port, "GET", "/book")
+            assert everything.json()["data"] == [
+                {"isbn": 1, "title": "SICP", "author": "abelson"}]
+
+            one = await http_request(port, "GET", "/book/1")
+            assert one.json()["data"]["title"] == "SICP"
+
+            updated = await http_request(
+                port, "PUT", "/book/1",
+                body=json.dumps({"isbn": 1, "title": "SICP2",
+                                 "author": "abelson"}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert updated.status == 200
+            one = await http_request(port, "GET", "/book/1")
+            assert one.json()["data"]["title"] == "SICP2"
+
+            gone = await http_request(port, "DELETE", "/book/1")
+            assert gone.status == 204
+            missing = await http_request(port, "GET", "/book/1")
+            assert missing.status == 404
+    run(main())
+
+
+# -- WebSocket ---------------------------------------------------------------
+
+async def _ws_client(port, path="/ws"):
+    """Handshake + return (reader, writer)."""
+    import base64
+    import os
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    key = base64.b64encode(os.urandom(16)).decode()
+    writer.write((
+        f"GET {path} HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+        f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+        f"Sec-WebSocket-Version: 13\r\n\r\n").encode())
+    await writer.drain()
+    head = await reader.readuntil(b"\r\n\r\n")
+    assert b"101" in head.split(b"\r\n")[0]
+    from gofr_tpu.websocket.frames import accept_key
+    assert accept_key(key).encode() in head
+    return reader, writer
+
+
+async def _ws_recv(reader):
+    from gofr_tpu.websocket.frames import decode_frame
+    buffer = b""
+    while True:
+        frame = decode_frame(buffer)
+        if frame is not None:
+            opcode, fin, payload, _ = frame
+            return opcode, payload
+        chunk = await reader.read(4096)
+        if not chunk:
+            raise ConnectionError("closed")
+        buffer += chunk
+
+
+def test_websocket_echo_roundtrip():
+    from gofr_tpu.websocket.frames import OP_TEXT, encode_frame
+
+    async def main():
+        app = make_app()
+
+        async def echo(ctx):
+            while True:
+                message = await ctx.read_message()
+                await ctx.write_message(f"echo: {message}")
+
+        app.websocket("/ws", echo)
+        async with serving(app) as port:
+            reader, writer = await _ws_client(port)
+            writer.write(encode_frame(OP_TEXT, b"hi", mask=True))
+            await writer.drain()
+            opcode, payload = await _ws_recv(reader)
+            assert opcode == OP_TEXT
+            assert payload == b"echo: hi"
+            writer.close()
+    run(main())
+
+
+def test_websocket_requires_upgrade_headers():
+    async def main():
+        app = make_app()
+        app.websocket("/ws", lambda ctx: None)
+        async with serving(app) as port:
+            plain = await http_request(port, "GET", "/ws")
+            assert plain.status == 426
+    run(main())
+
+
+def test_websocket_ping_pong_and_json():
+    from gofr_tpu.websocket.frames import (
+        OP_PING, OP_PONG, OP_TEXT, encode_frame)
+
+    async def main():
+        app = make_app()
+
+        async def once(ctx):
+            message = await ctx.read_message()
+            await ctx.write_message({"got": message})
+
+        app.websocket("/ws", once)
+        async with serving(app) as port:
+            reader, writer = await _ws_client(port)
+            writer.write(encode_frame(OP_PING, b"x", mask=True))
+            await writer.drain()
+            opcode, payload = await _ws_recv(reader)
+            assert opcode == OP_PONG and payload == b"x"
+            writer.write(encode_frame(OP_TEXT, b"42", mask=True))
+            await writer.drain()
+            opcode, payload = await _ws_recv(reader)
+            assert json.loads(payload) == {"got": "42"}
+            writer.close()
+    run(main())
+
+
+# -- gRPC (dynamic JSON unary) ----------------------------------------------
+
+def test_grpc_dynamic_unary():
+    import grpc
+
+    async def main():
+        app = make_app()
+        app.grpc_port = 0
+
+        def classify(ctx):
+            data = ctx.bind()
+            return {"label": f"class-{data['x']}", "param": ctx.param("x")}
+
+        app.register_grpc_unary("Predict", "classify", classify)
+        await app.start()
+        try:
+            port = app._grpc_server.bound_port
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                method = ch.unary_unary("/gofr.Predict/classify")
+                raw = await method(json.dumps({"x": 7}).encode())
+                data = json.loads(raw)["data"]
+                assert data["label"] == "class-7"
+                assert data["param"] == "7"
+        finally:
+            await app.stop()
+    run(main())
+
+
+def test_grpc_handler_error_maps_to_internal():
+    import grpc
+
+    async def main():
+        app = make_app()
+        app.grpc_port = 0
+        app.register_grpc_unary("Predict", "boom",
+                                lambda ctx: 1 / 0)
+        await app.start()
+        try:
+            port = app._grpc_server.bound_port
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                method = ch.unary_unary("/gofr.Predict/boom")
+                with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                    await method(b"{}")
+                assert excinfo.value.code() == grpc.StatusCode.INTERNAL
+        finally:
+            await app.stop()
+    run(main())
+
+
+# -- OpenAPI -----------------------------------------------------------------
+
+def test_openapi_routes(tmp_path, monkeypatch):
+    spec = {"openapi": "3.0.0", "info": {"title": "T", "version": "1"},
+            "paths": {"/hello": {"get": {"summary": "hi"}}}}
+    static = tmp_path / "static"
+    static.mkdir()
+    (static / "openapi.json").write_text(json.dumps(spec))
+    monkeypatch.chdir(tmp_path)
+
+    async def main():
+        app = make_app()
+        async with serving(app) as port:
+            got = await http_request(port, "GET", "/.well-known/openapi.json")
+            assert got.status == 200
+            assert got.json()["info"]["title"] == "T"
+            ui = await http_request(port, "GET", "/.well-known/swagger")
+            assert ui.status == 200
+            assert b"API documentation" in ui.body
+    run(main())
