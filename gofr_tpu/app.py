@@ -190,6 +190,28 @@ class App:
         self._cli_commands.append(
             CLICommand(pattern, handler, description, help_text))
 
+    # -- external DB injection (externalDB.go:5-39) -------------------------
+    def add_mongo(self, client=None) -> None:
+        if client is None:
+            from gofr_tpu.datasource.mongo import new_mongo
+            client = new_mongo(self.config, self.logger,
+                               self.container.metrics)
+        self.container.mongo = client
+
+    def add_cassandra(self, client=None) -> None:
+        if client is None:
+            from gofr_tpu.datasource.nosql import new_cassandra
+            client = new_cassandra(self.config, self.logger,
+                                   self.container.metrics)
+        self.container.cassandra = client
+
+    def add_clickhouse(self, client=None) -> None:
+        if client is None:
+            from gofr_tpu.datasource.nosql import new_clickhouse
+            client = new_clickhouse(self.config, self.logger,
+                                    self.container.metrics)
+        self.container.clickhouse = client
+
     # -- outbound services (gofr.go AddHTTPService) -------------------------
     def add_http_service(self, name: str, base_url: str, *options,
                          timeout: float = 30.0) -> None:
@@ -282,6 +304,16 @@ class App:
     async def start(self) -> None:
         self._shutdown = asyncio.Event()
         self._register_default_routes()
+
+        # dynamic batcher on the serving loop (north star: coalesce
+        # concurrent requests into one XLA execute)
+        if self.container.tpu is not None:
+            from gofr_tpu.tpu import DynamicBatcher
+            self.container.tpu_batcher = DynamicBatcher(
+                self.container.tpu,
+                max_batch=self.config.get_int("TPU_MAX_BATCH", 32),
+                max_delay_ms=self.config.get_float("TPU_BATCH_DELAY_MS", 2.0),
+                logger=self.logger)
 
         self._metrics_server = HTTPServer(
             self._metrics_dispatch, self.metrics_port, logger=self.logger)

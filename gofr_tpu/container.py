@@ -45,6 +45,7 @@ class Container:
         self.clickhouse = None
         self.file = None
         self.tpu = None
+        self.tpu_batcher = None  # created by App.start when tpu is wired
 
         self._start_time = time.time()
 

@@ -66,10 +66,40 @@ class Context:
         return self.container.redis
 
     @property
+    def mongo(self):
+        return self.container.mongo
+
+    @property
+    def cassandra(self):
+        return self.container.cassandra
+
+    @property
+    def clickhouse(self):
+        return self.container.clickhouse
+
+    @property
+    def pubsub(self):
+        return self.container.pubsub
+
+    @property
     def tpu(self):
         """The TPU executor datasource — the north-star addition
         (BASELINE.json: handlers call ``ctx.tpu.predict()``)."""
         return self.container.tpu
+
+    async def predict(self, model: str, example):
+        """Batched predict for ONE example through the app's dynamic
+        batcher (north star: coalesce concurrent requests into a single
+        XLA execute). Falls back to a direct executor call when no batcher
+        is running (CLI/cron contexts)."""
+        batcher = getattr(self.container, "tpu_batcher", None)
+        if batcher is not None:
+            return await batcher.predict(model, example)
+        import jax
+        import numpy as np
+        batch = jax.tree.map(lambda l: np.asarray(l)[None], example)
+        result = self.container.tpu.predict(model, batch)
+        return jax.tree.map(lambda l: np.asarray(l)[0], result)
 
     @property
     def file(self):

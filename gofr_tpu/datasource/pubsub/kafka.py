@@ -1,0 +1,423 @@
+"""Kafka backend — pure-Python wire-protocol client, no driver dependency.
+
+Capability parity with ``pkg/gofr/datasource/pubsub/kafka`` (kafka.go:42-105
+client + dial + writer config; Publish 127-165 w/ counters; Subscribe
+167-220 lazily creating a per-topic reader; commit-on-success via
+``kafkaMessage.Commit``; Create/DeleteTopic 247-264; health.go). The
+reference wraps segmentio/kafka-go; this zero-egress image has no Kafka
+driver, so the client speaks the wire protocol directly:
+
+  Metadata v1 · Produce v2 (message-set v1 + CRC32) · Fetch v2 ·
+  ListOffsets v1 · OffsetFetch v1 · OffsetCommit v2 ·
+  CreateTopics v0 · DeleteTopics v0
+
+Consumer model: per-topic poller thread fetches every partition from the
+group's committed offset (offset storage on the broker, simple static
+assignment — group *rebalancing* is delegated to deployment the way the
+reference delegates scale-out to consumer groups + k8s, SURVEY.md §2.8).
+Commit-on-success: ``Message.commit()`` advances the group offset.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from gofr_tpu.datasource.pubsub.base import Message, PubSub
+
+API_PRODUCE, API_FETCH, API_LIST_OFFSETS, API_METADATA = 0, 1, 2, 3
+API_OFFSET_COMMIT, API_OFFSET_FETCH = 8, 9
+API_CREATE_TOPICS, API_DELETE_TOPICS = 19, 20
+
+
+class KafkaError(Exception):
+    pass
+
+
+# -- primitive codecs --------------------------------------------------------
+
+def _string(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack(">h", -1)
+    raw = s.encode()
+    return struct.pack(">h", len(raw)) + raw
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.offset = 0
+
+    def int8(self):  return self._unpack(">b", 1)
+    def int16(self): return self._unpack(">h", 2)
+    def int32(self): return self._unpack(">i", 4)
+    def int64(self): return self._unpack(">q", 8)
+
+    def _unpack(self, fmt, size):
+        value = struct.unpack_from(fmt, self.data, self.offset)[0]
+        self.offset += size
+        return value
+
+    def string(self) -> Optional[str]:
+        n = self.int16()
+        if n == -1:
+            return None
+        raw = self.data[self.offset:self.offset + n]
+        self.offset += n
+        return raw.decode()
+
+    def raw_bytes(self) -> Optional[bytes]:
+        n = self.int32()
+        if n == -1:
+            return None
+        raw = self.data[self.offset:self.offset + n]
+        self.offset += n
+        return raw
+
+
+def encode_message_set(items: List[Tuple[bytes, bytes]]) -> bytes:
+    """Message-set v1 (magic 1): [offset][size][crc][magic][attrs][ts][k][v]."""
+    out = bytearray()
+    timestamp = int(time.time() * 1000)
+    for key, value in items:
+        body = (struct.pack(">bbq", 1, 0, timestamp) + _bytes(key or None)
+                + _bytes(value))
+        crc = zlib.crc32(body) & 0xFFFFFFFF
+        message = struct.pack(">I", crc) + body
+        out += struct.pack(">q", 0) + struct.pack(">i", len(message)) + message
+    return bytes(out)
+
+
+def decode_message_set(data: bytes, queue_offset: int
+                       ) -> List[Tuple[int, bytes, bytes]]:
+    """→ [(offset, key, value)]; tolerates a truncated trailing message."""
+    out: List[Tuple[int, bytes, bytes]] = []
+    reader = _Reader(data)
+    while reader.offset + 12 <= len(data):
+        offset = reader.int64()
+        size = reader.int32()
+        if reader.offset + size > len(data):
+            break
+        end = reader.offset + size
+        reader.int32()                       # crc (trusting TCP checksums)
+        magic = reader.int8()
+        attrs = reader.int8()
+        if magic >= 1:
+            reader.int64()                   # timestamp
+        key = reader.raw_bytes() or b""
+        value = reader.raw_bytes() or b""
+        if attrs & 0x07:
+            raise KafkaError("compressed message sets not supported")
+        if offset >= queue_offset:
+            out.append((offset, key, value))
+        reader.offset = end
+    return out
+
+
+class _Broker:
+    """One TCP connection + request/response correlation."""
+
+    def __init__(self, host: str, port: int, client_id: str):
+        self.sock = socket.create_connection((host, port), timeout=10.0)
+        self.client_id = client_id
+        self.correlation = 0
+        self.lock = threading.Lock()
+
+    def call(self, api_key: int, api_version: int, body: bytes) -> _Reader:
+        with self.lock:
+            self.correlation += 1
+            header = (struct.pack(">hhi", api_key, api_version,
+                                  self.correlation)
+                      + _string(self.client_id))
+            payload = header + body
+            self.sock.sendall(struct.pack(">i", len(payload)) + payload)
+            size = struct.unpack(">i", self._read(4))[0]
+            response = self._read(size)
+        reader = _Reader(response)
+        correlation = reader.int32()
+        if correlation != self.correlation:
+            raise KafkaError("correlation id mismatch")
+        return reader
+
+    def _read(self, n: int) -> bytes:
+        data = b""
+        while len(data) < n:
+            chunk = self.sock.recv(n - len(data))
+            if not chunk:
+                raise KafkaError("broker connection closed")
+            data += chunk
+        return data
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class KafkaClient(PubSub):
+    def __init__(self, config, logger, metrics):
+        self.logger = logger
+        self.metrics = metrics
+        broker = config.get_or_default("PUBSUB_BROKER",
+                                       config.get_or_default("KAFKA_BROKER",
+                                                             "localhost:9092"))
+        host, _, port = broker.partition(":")
+        self.bootstrap = (host, int(port or 9092))
+        self.group = config.get_or_default("CONSUMER_ID", "gofr-tpu")
+        self.client_id = config.get_or_default("APP_NAME", "gofr-tpu-app")
+        self.fetch_max_wait_ms = config.get_int("KAFKA_FETCH_MAX_WAIT_MS", 250)
+        self._brokers: Dict[Tuple[str, int], _Broker] = {}
+        self._meta_lock = threading.Lock()
+        self._leaders: Dict[Tuple[str, int], Tuple[str, int]] = {}
+        self._queues: Dict[str, "queue.Queue[Optional[Message]]"] = {}
+        self._pollers: Dict[str, threading.Thread] = {}
+        self._closed = False
+        self._broker(self.bootstrap)  # fail fast if unreachable
+        logger.info("kafka connected %s:%d group=%s", *self.bootstrap,
+                    self.group)
+
+    def _broker(self, addr: Tuple[str, int]) -> _Broker:
+        broker = self._brokers.get(addr)
+        if broker is None:
+            broker = _Broker(addr[0], addr[1], self.client_id)
+            self._brokers[addr] = broker
+        return broker
+
+    # -- metadata / leader routing -----------------------------------------
+    def _refresh_metadata(self, topic: str) -> List[int]:
+        reader = self._broker(self.bootstrap).call(
+            API_METADATA, 1, struct.pack(">i", 1) + _string(topic))
+        nodes: Dict[int, Tuple[str, int]] = {}
+        for _ in range(reader.int32()):          # brokers
+            node_id = reader.int32()
+            host = reader.string()
+            port = reader.int32()
+            reader.string()                      # rack
+            nodes[node_id] = (host, port)
+        reader.int32()                           # controller id
+        partitions: List[int] = []
+        for _ in range(reader.int32()):          # topics
+            reader.int16()                       # topic error
+            name = reader.string()
+            reader.int8()                        # is_internal
+            for _ in range(reader.int32()):
+                reader.int16()                   # partition error
+                partition = reader.int32()
+                leader = reader.int32()
+                for _ in range(reader.int32()):  # replicas
+                    reader.int32()
+                for _ in range(reader.int32()):  # isr
+                    reader.int32()
+                if name == topic:
+                    partitions.append(partition)
+                    if leader in nodes:
+                        with self._meta_lock:
+                            self._leaders[(topic, partition)] = nodes[leader]
+        return sorted(partitions)
+
+    def _leader(self, topic: str, partition: int) -> _Broker:
+        addr = self._leaders.get((topic, partition))
+        if addr is None:
+            self._refresh_metadata(topic)
+            addr = self._leaders.get((topic, partition), self.bootstrap)
+        return self._broker(addr)
+
+    # -- produce ------------------------------------------------------------
+    def publish(self, topic: str, payload: bytes, key: bytes = b"") -> None:
+        self.metrics.increment_counter("app_pubsub_publish_total_count",
+                                       topic=topic)
+        partitions = self._refresh_metadata(topic) or [0]
+        partition = (zlib.crc32(key) % len(partitions)) if key \
+            else int(time.time() * 1e6) % len(partitions)
+        message_set = encode_message_set([(key, payload)])
+        body = (struct.pack(">hi", 1, 10000)          # acks=1, timeout
+                + struct.pack(">i", 1) + _string(topic)
+                + struct.pack(">i", 1) + struct.pack(">i", partition)
+                + _bytes(message_set))
+        reader = self._leader(topic, partition).call(API_PRODUCE, 2, body)
+        for _ in range(reader.int32()):
+            reader.string()                           # topic
+            for _ in range(reader.int32()):
+                reader.int32()                        # partition
+                error = reader.int16()
+                reader.int64()                        # base offset
+                reader.int64()                        # log append time
+                if error:
+                    raise KafkaError(f"produce error code {error}")
+        self.metrics.increment_counter("app_pubsub_publish_success_count",
+                                       topic=topic)
+
+    # -- offsets ------------------------------------------------------------
+    def _committed_offset(self, topic: str, partition: int) -> int:
+        body = (_string(self.group) + struct.pack(">i", 1) + _string(topic)
+                + struct.pack(">i", 1) + struct.pack(">i", partition))
+        reader = self._broker(self.bootstrap).call(API_OFFSET_FETCH, 1, body)
+        for _ in range(reader.int32()):
+            reader.string()
+            for _ in range(reader.int32()):
+                reader.int32()
+                offset = reader.int64()
+                reader.string()                       # metadata
+                reader.int16()                        # error
+                return max(0, offset)
+        return 0
+
+    def _earliest_offset(self, topic: str, partition: int) -> int:
+        body = (struct.pack(">i", -1) + struct.pack(">i", 1) + _string(topic)
+                + struct.pack(">i", 1)
+                + struct.pack(">iq", partition, -2))   # -2 = earliest
+        reader = self._leader(topic, partition).call(API_LIST_OFFSETS, 1,
+                                                     body)
+        for _ in range(reader.int32()):
+            reader.string()
+            for _ in range(reader.int32()):
+                reader.int32()
+                error = reader.int16()
+                reader.int64()                        # timestamp
+                offset = reader.int64()
+                if error:
+                    raise KafkaError(f"list offsets error {error}")
+                return offset
+        return 0
+
+    def _commit_offset(self, topic: str, partition: int, offset: int) -> None:
+        body = (_string(self.group) + struct.pack(">i", -1) + _string("")
+                + struct.pack(">q", -1)
+                + struct.pack(">i", 1) + _string(topic)
+                + struct.pack(">i", 1)
+                + struct.pack(">iq", partition, offset) + _string(None))
+        reader = self._broker(self.bootstrap).call(API_OFFSET_COMMIT, 2, body)
+        for _ in range(reader.int32()):
+            reader.string()
+            for _ in range(reader.int32()):
+                reader.int32()
+                error = reader.int16()
+                if error:
+                    self.logger.error("kafka offset commit error %d", error)
+
+    # -- fetch loop (per-topic reader, kafka.go:181-186) --------------------
+    def _poll_topic(self, topic: str) -> None:
+        q = self._queues[topic]
+        offsets: Dict[int, int] = {}
+        try:
+            partitions = self._refresh_metadata(topic)
+            for partition in partitions:
+                committed = self._committed_offset(topic, partition)
+                offsets[partition] = committed or self._earliest_offset(
+                    topic, partition)
+            while not self._closed:
+                got_any = False
+                for partition in partitions:
+                    for offset, key, value in self._fetch(
+                            topic, partition, offsets[partition]):
+                        offsets[partition] = offset + 1
+                        committer = self._make_committer(topic, partition,
+                                                         offset + 1)
+                        q.put(Message(topic, value, key,
+                                      metadata={"partition": partition,
+                                                "offset": offset},
+                                      committer=committer))
+                        got_any = True
+                if not got_any:
+                    time.sleep(self.fetch_max_wait_ms / 1000.0)
+        except Exception as exc:
+            if not self._closed:
+                self.logger.error("kafka poller %s died: %r", topic, exc)
+            q.put(None)
+
+    def _make_committer(self, topic, partition, next_offset):
+        return lambda: self._commit_offset(topic, partition, next_offset)
+
+    def _fetch(self, topic: str, partition: int,
+               offset: int) -> List[Tuple[int, bytes, bytes]]:
+        body = (struct.pack(">iii", -1, self.fetch_max_wait_ms, 1)
+                + struct.pack(">i", 1) + _string(topic)
+                + struct.pack(">i", 1)
+                + struct.pack(">iqi", partition, offset, 4 * 1024 * 1024))
+        reader = self._leader(topic, partition).call(API_FETCH, 2, body)
+        reader.int32()                                # throttle time
+        out: List[Tuple[int, bytes, bytes]] = []
+        for _ in range(reader.int32()):
+            reader.string()
+            for _ in range(reader.int32()):
+                reader.int32()                        # partition
+                error = reader.int16()
+                reader.int64()                        # high watermark
+                message_set = reader.raw_bytes() or b""
+                if error:
+                    raise KafkaError(f"fetch error code {error}")
+                out.extend(decode_message_set(message_set, offset))
+        return out
+
+    async def subscribe(self, topic: str) -> Optional[Message]:
+        import asyncio
+        self.metrics.increment_counter("app_pubsub_subscribe_total_count",
+                                       topic=topic)
+        if topic not in self._pollers:
+            self._queues[topic] = queue.Queue(maxsize=65536)
+            poller = threading.Thread(target=self._poll_topic, args=(topic,),
+                                      daemon=True, name=f"kafka-{topic}")
+            self._pollers[topic] = poller
+            poller.start()
+        message = await asyncio.get_running_loop().run_in_executor(
+            None, self._queues[topic].get)
+        if message is not None:
+            self.metrics.increment_counter(
+                "app_pubsub_subscribe_success_count", topic=topic)
+        return message
+
+    # -- topic admin (kafka.go:247-264) -------------------------------------
+    def create_topic(self, topic: str, partitions: int = 1,
+                     replication: int = 1) -> None:
+        body = (struct.pack(">i", 1) + _string(topic)
+                + struct.pack(">ih", partitions, replication)
+                + struct.pack(">i", 0)                # assignments
+                + struct.pack(">i", 0)                # configs
+                + struct.pack(">i", 10000))           # timeout
+        reader = self._broker(self.bootstrap).call(API_CREATE_TOPICS, 0, body)
+        for _ in range(reader.int32()):
+            reader.string()
+            error = reader.int16()
+            if error and error != 36:                 # 36 = already exists
+                raise KafkaError(f"create topic error {error}")
+
+    def delete_topic(self, topic: str) -> None:
+        body = (struct.pack(">i", 1) + _string(topic)
+                + struct.pack(">i", 10000))
+        reader = self._broker(self.bootstrap).call(API_DELETE_TOPICS, 0, body)
+        for _ in range(reader.int32()):
+            reader.string()
+            error = reader.int16()
+            if error and error != 3:                  # 3 = unknown topic
+                raise KafkaError(f"delete topic error {error}")
+
+    def health_check(self) -> dict:
+        try:
+            self._broker(self.bootstrap).call(
+                API_METADATA, 1, struct.pack(">i", 0))
+            return {"status": "UP",
+                    "details": {"backend": "KAFKA",
+                                "broker": f"{self.bootstrap[0]}:"
+                                          f"{self.bootstrap[1]}",
+                                "group": self.group}}
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"error": repr(exc)}}
+
+    def close(self) -> None:
+        self._closed = True
+        for q in self._queues.values():
+            q.put(None)
+        for broker in self._brokers.values():
+            broker.close()

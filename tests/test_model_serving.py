@@ -1,0 +1,76 @@
+"""End-to-end model serving: HTTP handler → ctx.predict → dynamic batcher
+→ executor → compiled XLA — the full north-star path (BASELINE.json) on
+the CPU backend."""
+
+import asyncio
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from tests.util import http_request, make_app, run, serving
+
+
+def _register_tiny_classifier(app):
+    """A 'model': logits = x @ W, W fixed."""
+    weights = {"w": jnp.eye(4, 3)}
+
+    def fn(params, x):
+        return x @ params["w"]
+
+    app.add_model("clf", fn, params=weights, buckets=(1, 2, 4, 8))
+    return app
+
+
+def test_http_classify_through_batcher():
+    async def main():
+        app = make_app({"TPU_ENABLED": "true"})
+        _register_tiny_classifier(app)
+
+        async def classify(ctx):
+            data = ctx.bind()
+            example = np.asarray(data["x"], np.float32)
+            logits = await ctx.predict("clf", example)
+            return {"label": int(np.argmax(logits)),
+                    "logits": [float(v) for v in logits]}
+
+        app.post("/classify", classify)
+        async with serving(app) as port:
+            results = await asyncio.gather(*[
+                http_request(
+                    port, "POST", "/classify",
+                    body=json.dumps(
+                        {"x": [0, 0, 0, 0][:i] + [1.0]
+                         + [0] * (3 - i)}).encode(),
+                    headers={"Content-Type": "application/json"})
+                for i in range(3)])
+            labels = [r.json()["data"]["label"] for r in results]
+            assert labels == [0, 1, 2]
+            # batch-size histogram was recorded (coalescing happened)
+            snapshot = app.container.metrics.snapshot()
+            assert "app_tpu_batch_size" in snapshot
+    run(main())
+
+
+def test_ctx_predict_without_batcher(mock_container):
+    """CLI/cron contexts: direct executor fallback."""
+    from gofr_tpu.context import Context
+    from gofr_tpu.tpu import Executor
+    executor = Executor(mock_container.logger, mock_container.metrics)
+    executor.register("double", lambda p, x: x * 2.0, {}, buckets=(1,))
+    mock_container.tpu = executor
+    ctx = Context(None, mock_container)
+    out = asyncio.run(ctx.predict("double", np.ones((3,), np.float32)))
+    np.testing.assert_allclose(out, [2.0, 2.0, 2.0])
+
+
+def test_tpu_health_in_wellknown():
+    async def main():
+        app = make_app({"TPU_ENABLED": "true"})
+        _register_tiny_classifier(app)
+        async with serving(app) as port:
+            health = await http_request(port, "GET", "/.well-known/health")
+            body = health.json()
+            assert body["tpu"]["status"] == "UP"
+            assert "devices" in body["tpu"]
+    run(main())

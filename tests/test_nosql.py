@@ -1,0 +1,105 @@
+"""Mongo / Cassandra / ClickHouse datasource tests (reference style:
+mock seams + in-memory engines, SURVEY.md §4)."""
+
+import dataclasses
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.datasource.mongo import InMemoryMongo, new_mongo
+from gofr_tpu.datasource.nosql import (
+    MockCassandra,
+    MockClickhouse,
+    new_cassandra,
+    new_clickhouse,
+)
+
+
+@pytest.fixture()
+def mongo(mock_container):
+    return InMemoryMongo(mock_container.logger, mock_container.metrics)
+
+
+def test_mongo_crud_roundtrip(mongo):
+    doc_id = mongo.insert_one("users", {"name": "ada", "age": 36})
+    assert doc_id == 1
+    mongo.insert_many("users", [{"name": "grace", "age": 85},
+                                {"name": "edsger", "age": 72}])
+    assert mongo.count_documents("users") == 3
+    assert mongo.find_one("users", {"name": "ada"})["age"] == 36
+    assert [d["name"] for d in mongo.find("users", {"age": {"$gt": 50}})] \
+        == ["grace", "edsger"]
+    assert mongo.update_by_id("users", doc_id, {"$set": {"age": 37}}) == 1
+    assert mongo.find_one("users", {"_id": doc_id})["age"] == 37
+    assert mongo.delete_one("users", {"name": "edsger"}) == 1
+    assert mongo.delete_many("users", {}) == 2
+    mongo.drop_collection("users")
+    assert mongo.count_documents("users") == 0
+
+
+def test_mongo_filter_operators(mongo):
+    mongo.insert_many("n", [{"x": i} for i in range(5)])
+    assert mongo.count_documents("n", {"x": {"$gte": 3}}) == 2
+    assert mongo.count_documents("n", {"x": {"$lt": 2}}) == 2
+    assert mongo.count_documents("n", {"x": {"$ne": 0}}) == 4
+    assert mongo.count_documents("n", {"x": {"$in": [1, 3]}}) == 2
+    with pytest.raises(Exception):
+        mongo.find("n", {"x": {"$regex": "nope"}})
+
+
+def test_mongo_isolation_on_returned_docs(mongo):
+    mongo.insert_one("c", {"nested": {"a": 1}})
+    out = mongo.find_one("c")
+    out["nested"]["a"] = 999
+    assert mongo.find_one("c")["nested"]["a"] == 1
+
+
+def test_new_mongo_memory_engine(mock_container):
+    client = new_mongo(MapConfig({}), mock_container.logger,
+                       mock_container.metrics)
+    assert isinstance(client, InMemoryMongo)
+    assert client.health_check()["status"] == "UP"
+
+
+@dataclasses.dataclass
+class Employee:
+    id: int = 0
+    name: str = ""
+
+
+def test_cassandra_mock_seam(mock_container):
+    cassandra = new_cassandra(MapConfig({}), mock_container.logger,
+                              mock_container.metrics)
+    assert isinstance(cassandra, MockCassandra)
+    cassandra.stub("FROM employees", [{"id": 1, "name": "ada"}])
+    rows = cassandra.query(Employee, "SELECT * FROM employees WHERE id = ?",
+                           1)
+    assert rows == [Employee(id=1, name="ada")]
+    cassandra.exec("INSERT INTO employees (id, name) VALUES (?, ?)", 2, "g")
+    assert cassandra.exec_cas("INSERT ... IF NOT EXISTS") is True
+    assert len(cassandra.executed) == 3
+    assert cassandra.health_check()["status"] == "UP"
+
+
+def test_clickhouse_mock_seam(mock_container):
+    clickhouse = new_clickhouse(MapConfig({}), mock_container.logger,
+                                mock_container.metrics)
+    assert isinstance(clickhouse, MockClickhouse)
+    clickhouse.stub("FROM events", [{"id": 7}])
+    assert clickhouse.select(None, "SELECT id FROM events") == [{"id": 7}]
+    clickhouse.async_insert("INSERT INTO events VALUES (?)", 1)
+    assert clickhouse.async_inserts == [("INSERT INTO events VALUES (?)",
+                                         (1,))]
+
+
+def test_app_external_db_injection():
+    from tests.util import make_app
+    app = make_app()
+    app.add_mongo()
+    app.add_cassandra()
+    app.add_clickhouse()
+    assert app.container.mongo is not None
+    health = app.container.health()
+    assert "mongo" in health and "cassandra" in health \
+        and "clickhouse" in health

@@ -1,0 +1,354 @@
+"""MQTT + Kafka wire clients against in-process fake brokers — the
+"miniredis" strategy applied to brokers (SURVEY.md §4: test pub/sub without
+real infrastructure, but over the real wire protocol)."""
+
+import asyncio
+import queue
+import socket
+import struct
+import threading
+import zlib
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container import new_mock_container
+
+
+# -- fake MQTT broker --------------------------------------------------------
+
+class FakeMQTTBroker:
+    """CONNECT→CONNACK, SUBSCRIBE→SUBACK, PUBLISH→fan-out to subscribers."""
+
+    def __init__(self):
+        self.server = socket.socket()
+        self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.server.bind(("127.0.0.1", 0))
+        self.server.listen(8)
+        self.port = self.server.getsockname()[1]
+        self.subscribers = []
+        self.lock = threading.Lock()
+        self.running = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while self.running:
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _read_packet(self, conn):
+        first = conn.recv(1)
+        if not first:
+            return None, None
+        length, multiplier = 0, 1
+        while True:
+            byte = conn.recv(1)[0]
+            length += (byte & 0x7F) * multiplier
+            if not byte & 0x80:
+                break
+            multiplier *= 128
+        body = b""
+        while len(body) < length:
+            body += conn.recv(length - len(body))
+        return first[0], body
+
+    def _serve(self, conn):
+        try:
+            while self.running:
+                packet_type, body = self._read_packet(conn)
+                if packet_type is None:
+                    return
+                kind = packet_type & 0xF0
+                if kind == 0x10:      # CONNECT → CONNACK ok
+                    conn.sendall(bytes([0x20, 2, 0, 0]))
+                elif kind == 0x80:    # SUBSCRIBE → SUBACK
+                    packet_id = body[:2]
+                    with self.lock:
+                        self.subscribers.append(conn)
+                    conn.sendall(bytes([0x90, 3]) + packet_id + b"\x00")
+                elif kind == 0x30:    # PUBLISH → fan out verbatim
+                    frame = bytes([packet_type])
+                    n = len(body)
+                    encoded = bytearray()
+                    while True:
+                        digit = n % 128
+                        n //= 128
+                        encoded.append(digit | (0x80 if n else 0))
+                        if not n:
+                            break
+                    frame += bytes(encoded) + body
+                    with self.lock:
+                        targets = list(self.subscribers)
+                    for target in targets:
+                        try:
+                            target.sendall(frame)
+                        except OSError:
+                            pass
+                elif kind == 0xC0:    # PINGREQ → PINGRESP
+                    conn.sendall(bytes([0xD0, 0]))
+        except (OSError, IndexError):
+            pass
+
+    def stop(self):
+        self.running = False
+        self.server.close()
+
+
+def test_mqtt_roundtrip():
+    from gofr_tpu.datasource.pubsub.mqtt import MQTTClient
+    broker = FakeMQTTBroker()
+    container = new_mock_container()
+    client = MQTTClient(MapConfig({"MQTT_HOST": "127.0.0.1",
+                                   "MQTT_PORT": str(broker.port)}),
+                        container.logger, container.metrics)
+    try:
+        async def scenario():
+            first = asyncio.ensure_future(client.subscribe("orders"))
+            await asyncio.sleep(0.1)   # let SUBSCRIBE land
+            client.publish("orders", b'{"id": 1}')
+            message = await asyncio.wait_for(first, 5.0)
+            assert message.topic == "orders"
+            assert message.bind() == {"id": 1}
+            message.commit()
+
+        asyncio.run(scenario())
+        assert client.health_check()["status"] == "UP"
+    finally:
+        client.close()
+        broker.stop()
+
+
+def test_mqtt_codec_symmetry():
+    from gofr_tpu.datasource.pubsub.mqtt import (
+        decode_publish, encode_publish)
+    frame = encode_publish("a/b", b"payload", packet_id=7, qos=1)
+    # strip fixed header (type byte + 1-byte varint for short frames)
+    topic, payload, qos, packet_id = decode_publish(frame[0] & 0x0F,
+                                                    frame[2:])
+    assert (topic, payload, qos, packet_id) == ("a/b", b"payload", 1, 7)
+
+
+# -- fake Kafka broker -------------------------------------------------------
+
+class FakeKafkaBroker:
+    """Single-node, in-memory log; speaks Metadata v1 / Produce v2 /
+    Fetch v2 / ListOffsets v1 / OffsetFetch v1 / OffsetCommit v2 /
+    CreateTopics v0 / DeleteTopics v0."""
+
+    def __init__(self):
+        self.server = socket.socket()
+        self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.server.bind(("127.0.0.1", 0))
+        self.server.listen(8)
+        self.port = self.server.getsockname()[1]
+        self.logs = {}      # (topic, partition) -> list[(key, value)]
+        self.offsets = {}   # (group, topic, partition) -> offset
+        self.running = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while self.running:
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        from gofr_tpu.datasource.pubsub.kafka import (
+            _Reader, _bytes, _string, decode_message_set,
+            encode_message_set)
+        try:
+            while self.running:
+                raw = b""
+                while len(raw) < 4:
+                    chunk = conn.recv(4 - len(raw))
+                    if not chunk:
+                        return
+                    raw += chunk
+                size = struct.unpack(">i", raw)[0]
+                payload = b""
+                while len(payload) < size:
+                    payload += conn.recv(size - len(payload))
+                reader = _Reader(payload)
+                api_key = reader.int16()
+                reader.int16()           # api version
+                correlation = reader.int32()
+                reader.string()          # client id
+                body = self._handle(api_key, reader, _string, _bytes,
+                                    encode_message_set, decode_message_set)
+                response = struct.pack(">i", correlation) + body
+                conn.sendall(struct.pack(">i", len(response)) + response)
+        except OSError:
+            pass
+
+    def _handle(self, api_key, reader, _string, _bytes, enc_set, dec_set):
+        if api_key == 3:    # Metadata
+            count = reader.int32()
+            topics = [reader.string() for _ in range(count)]
+            if not topics:
+                topics = sorted({t for t, _ in self.logs})
+            out = struct.pack(">i", 1)           # one broker
+            out += struct.pack(">i", 0) + _string("127.0.0.1") \
+                + struct.pack(">i", self.port) + _string(None)
+            out += struct.pack(">i", 0)          # controller
+            out += struct.pack(">i", len(topics))
+            for topic in topics:
+                self.logs.setdefault((topic, 0), [])
+                out += struct.pack(">h", 0) + _string(topic) + b"\x00"
+                out += struct.pack(">i", 1)      # one partition
+                out += struct.pack(">hii", 0, 0, 0)   # err, part, leader
+                out += struct.pack(">i", 0) + struct.pack(">i", 0)
+            return out
+        if api_key == 0:    # Produce
+            reader.int16()  # acks
+            reader.int32()  # timeout
+            reader.int32()  # topic count (assume 1)
+            topic = reader.string()
+            reader.int32()  # partition count (assume 1)
+            partition = reader.int32()
+            message_set = reader.raw_bytes()
+            log = self.logs.setdefault((topic, partition), [])
+            base = len(log)
+            for _, key, value in dec_set(message_set, 0):
+                log.append((key, value))
+            return (struct.pack(">i", 1) + _string(topic)
+                    + struct.pack(">i", 1)
+                    + struct.pack(">ihqq", partition, 0, base, -1))
+        if api_key == 1:    # Fetch
+            reader.int32()  # replica
+            reader.int32()  # max wait
+            reader.int32()  # min bytes
+            reader.int32()  # topic count
+            topic = reader.string()
+            reader.int32()  # partition count
+            partition = reader.int32()
+            offset = reader.int64()
+            log = self.logs.get((topic, partition), [])
+            items = log[offset:]
+            message_set = b""
+            for i, (key, value) in enumerate(items):
+                one = enc_set([(key, value)])
+                # rewrite the offset field of the single message
+                message_set += struct.pack(">q", offset + i) + one[8:]
+            return (struct.pack(">i", 0)         # throttle
+                    + struct.pack(">i", 1) + _string(topic)
+                    + struct.pack(">i", 1)
+                    + struct.pack(">ihq", partition, 0, len(log))
+                    + _bytes(message_set))
+        if api_key == 2:    # ListOffsets (earliest)
+            return (struct.pack(">i", 1) + _string("t")
+                    + struct.pack(">i", 1)
+                    + struct.pack(">ihqq", 0, 0, -1, 0))
+        if api_key == 9:    # OffsetFetch
+            group = reader.string()
+            reader.int32()
+            topic = reader.string()
+            reader.int32()
+            partition = reader.int32()
+            offset = self.offsets.get((group, topic, partition), -1)
+            return (struct.pack(">i", 1) + _string(topic)
+                    + struct.pack(">i", 1) + struct.pack(">iq", partition,
+                                                         offset)
+                    + _string(None) + struct.pack(">h", 0))
+        if api_key == 8:    # OffsetCommit
+            group = reader.string()
+            reader.int32()
+            reader.string()
+            reader.int64()
+            reader.int32()
+            topic = reader.string()
+            reader.int32()
+            partition = reader.int32()
+            offset = reader.int64()
+            self.offsets[(group, topic, partition)] = offset
+            return (struct.pack(">i", 1) + _string(topic)
+                    + struct.pack(">i", 1) + struct.pack(">ih", partition, 0))
+        if api_key == 19:   # CreateTopics
+            reader.int32()
+            topic = reader.string()
+            self.logs.setdefault((topic, 0), [])
+            return struct.pack(">i", 1) + _string(topic) + struct.pack(">h", 0)
+        if api_key == 20:   # DeleteTopics
+            reader.int32()
+            topic = reader.string()
+            self.logs.pop((topic, 0), None)
+            return struct.pack(">i", 1) + _string(topic) + struct.pack(">h", 0)
+        raise AssertionError(f"fake broker: unhandled api {api_key}")
+
+    def stop(self):
+        self.running = False
+        self.server.close()
+
+
+@pytest.fixture()
+def kafka_client():
+    from gofr_tpu.datasource.pubsub.kafka import KafkaClient
+    broker = FakeKafkaBroker()
+    container = new_mock_container()
+    client = KafkaClient(
+        MapConfig({"PUBSUB_BROKER": f"127.0.0.1:{broker.port}",
+                   "CONSUMER_ID": "workers",
+                   "KAFKA_FETCH_MAX_WAIT_MS": "20"}),
+        container.logger, container.metrics)
+    yield client, broker
+    client.close()
+    broker.stop()
+
+
+def test_kafka_produce_fetch_commit(kafka_client):
+    client, broker = kafka_client
+    client.create_topic("orders")
+    client.publish("orders", b'{"n": 1}')
+    client.publish("orders", b'{"n": 2}')
+    assert broker.logs[("orders", 0)] == [(b"", b'{"n": 1}'),
+                                          (b"", b'{"n": 2}')]
+
+    async def scenario():
+        first = await asyncio.wait_for(client.subscribe("orders"), 5.0)
+        second = await asyncio.wait_for(client.subscribe("orders"), 5.0)
+        assert first.bind() == {"n": 1}
+        assert second.bind() == {"n": 2}
+        assert first.metadata["offset"] == 0
+        first.commit()
+        second.commit()
+
+    asyncio.run(scenario())
+    assert broker.offsets[("workers", "orders", 0)] == 2
+
+
+def test_kafka_resumes_from_committed_offset(kafka_client):
+    client, broker = kafka_client
+    client.publish("jobs", b"a")
+    client.publish("jobs", b"b")
+    broker.offsets[("workers", "jobs", 0)] = 1  # pretend 'a' was consumed
+
+    async def scenario():
+        message = await asyncio.wait_for(client.subscribe("jobs"), 5.0)
+        assert message.value == b"b"
+
+    asyncio.run(scenario())
+
+
+def test_kafka_message_set_codec():
+    from gofr_tpu.datasource.pubsub.kafka import (
+        decode_message_set, encode_message_set)
+    blob = encode_message_set([(b"k1", b"v1"), (b"", b"v2")])
+    out = decode_message_set(blob, 0)
+    assert [(k, v) for _, k, v in out] == [(b"k1", b"v1"), (b"", b"v2")]
+    # crc sanity: payload bytes are intact
+    assert zlib.crc32(b"v1") == zlib.crc32(out[0][2])
+
+
+def test_kafka_topic_admin_and_health(kafka_client):
+    client, broker = kafka_client
+    client.create_topic("t1")
+    assert ("t1", 0) in broker.logs
+    client.delete_topic("t1")
+    assert ("t1", 0) not in broker.logs
+    assert client.health_check()["status"] == "UP"
