@@ -1,0 +1,44 @@
+"""CLI example — parity with reference examples/using-cmd: sub-commands
+with flags, plus an offline TPU predict command (CLI contexts fall back to
+direct executor calls — no server loop needed)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_cmd
+
+
+def hello(ctx):
+    return f"Hello {ctx.param('name') or 'World'}!"
+
+
+def classify(ctx):
+    import asyncio
+
+    import jax
+    import numpy as np
+
+    from gofr_tpu.models import resnet
+    from gofr_tpu.tpu import Executor
+
+    cfg = resnet.config("tiny")
+    params = resnet.init(cfg, jax.random.PRNGKey(0))
+    executor = Executor(ctx.logger, ctx.metrics)
+    executor.register("resnet", lambda p, x: resnet.apply(p, cfg, x),
+                      params, buckets=(1,))
+    ctx.container.tpu = executor
+    image = np.random.default_rng(0).standard_normal(
+        (cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    logits = asyncio.run(ctx.predict("resnet", image))
+    return {"label": int(logits.argmax())}
+
+
+app = new_cmd()
+app.sub_command("hello", hello, description="greet",
+                help_text="hello -name=you")
+app.sub_command("classify", classify,
+                description="classify a random image offline")
+
+if __name__ == "__main__":
+    sys.exit(app.run() or 0)

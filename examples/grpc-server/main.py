@@ -1,0 +1,51 @@
+"""gRPC server example — BERT-base embeddings with dynamic batching
+(BASELINE.md config 3; reference parity: examples/grpc-server).
+
+Exposes ``/gofr.Embeddings/embed`` (dynamic JSON unary — no protoc):
+request ``{"token_ids": [...]}``, reply ``{"data": {"embedding": [...]}}``.
+Set ``BERT_PRESET=tiny`` for fast compile.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from gofr_tpu import new_app
+
+MAX_LEN = 64
+
+
+async def embed(ctx):
+    data = ctx.bind()
+    ids = np.zeros((MAX_LEN,), np.int32)
+    mask = np.zeros((MAX_LEN,), np.int32)
+    tokens = data["token_ids"][:MAX_LEN]
+    ids[:len(tokens)] = tokens
+    mask[:len(tokens)] = 1
+    out = await ctx.predict("bert", (ids, mask))
+    return {"embedding": [float(v) for v in out]}
+
+
+def build_app():
+    import jax
+
+    from gofr_tpu.models import bert
+
+    app = new_app()
+    preset = os.environ.get("BERT_PRESET", "base")
+    cfg = bert.config(preset, max_len=MAX_LEN)
+    params = bert.init(cfg, jax.random.PRNGKey(0))
+
+    def fn(params, inputs):
+        ids, mask = inputs
+        return bert.apply(params, cfg, ids, mask)["mean"]
+
+    app.add_model("bert", fn, params=params, buckets=(1, 4, 16, 32))
+    app.register_grpc_unary("Embeddings", "embed", embed)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

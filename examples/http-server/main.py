@@ -1,6 +1,15 @@
-"""HTTP server example — parity with reference examples/http-server/main.go."""
+"""HTTP server example — parity with reference examples/http-server plus
+the north-star ResNet-50 classify endpoint (BASELINE.md configs 1+2).
+
+Run: ``python main.py`` → GET /hello, GET /user/{id}, POST /classify.
+Set ``RESNET_PRESET=tiny`` for a fast-compiling model on CPU.
+"""
+import os
 import sys
-sys.path.insert(0, "../..")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
 
 from gofr_tpu import new_app
 from gofr_tpu.http.errors import EntityNotFound
@@ -24,10 +33,34 @@ def create_user(ctx):
     return data
 
 
-app = new_app()
-app.get("/hello", hello)
-app.get("/user/{id}", get_user)
-app.post("/user", create_user)
+async def classify(ctx):
+    """One image in (nested-list float array), one label out — coalesced
+    with concurrent requests into a single XLA execute."""
+    data = ctx.bind()
+    image = np.asarray(data["image"], np.float32)
+    logits = await ctx.predict("resnet50", image)
+    top = int(np.argmax(logits))
+    return {"label": top, "score": float(logits[top])}
+
+
+def build_app():
+    import jax
+
+    from gofr_tpu.models import resnet
+
+    app = new_app()
+    app.get("/hello", hello)
+    app.get("/user/{id}", get_user)
+    app.post("/user", create_user)
+
+    preset = os.environ.get("RESNET_PRESET", "50")
+    cfg = resnet.config(preset)
+    params = resnet.init(cfg, jax.random.PRNGKey(0))
+    app.add_model("resnet50", lambda p, x: resnet.apply(p, cfg, x),
+                  params=params, buckets=(1, 4, 16, 32))
+    app.post("/classify", classify)
+    return app
+
 
 if __name__ == "__main__":
-    app.run()
+    build_app().run()

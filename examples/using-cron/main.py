@@ -1,0 +1,26 @@
+"""Cron example — parity with reference examples/using-cron: a 5-field
+spec job running on the app lifecycle, with a TPU health sweep."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_app
+
+
+def heartbeat(ctx):
+    ctx.logger.info("cron heartbeat", uptime=ctx.container.health()
+                    .get("uptime_seconds"))
+
+
+def tpu_health_sweep(ctx):
+    if ctx.tpu is not None:
+        ctx.logger.info("tpu health", **ctx.tpu.health_check())
+
+
+app = new_app()
+app.add_cron_job("* * * * *", "heartbeat", heartbeat)
+app.add_cron_job("*/5 * * * *", "tpu-health", tpu_health_sweep)
+
+if __name__ == "__main__":
+    app.run()

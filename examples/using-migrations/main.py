@@ -1,0 +1,45 @@
+"""Migrations example — parity with reference examples/using-migrations:
+versioned, transactional schema bootstrap + CRUD scaffolding on top."""
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# self-contained demo: sqlite in memory + in-process redis
+os.environ.setdefault("DB_DIALECT", "sqlite")
+os.environ.setdefault("DB_NAME", ":memory:")
+os.environ.setdefault("REDIS_HOST", "memory")
+
+from gofr_tpu import new_app
+from gofr_tpu.migration import Migration
+
+
+def create_employee_table(ds):
+    ds.sql.execute(
+        "CREATE TABLE employee (id INTEGER PRIMARY KEY, name TEXT, "
+        "department TEXT)")
+
+
+def seed_employees(ds):
+    ds.sql.execute("INSERT INTO employee VALUES (?, ?, ?)", 1, "ada",
+                   "compute")
+    ds.redis.set("employee:seeded", "true")
+
+
+@dataclasses.dataclass
+class Employee:
+    id: int = 0
+    name: str = ""
+    department: str = ""
+
+
+app = new_app()
+app.migrate({
+    1: Migration(up=create_employee_table),
+    2: Migration(up=seed_employees),
+})
+app.add_rest_handlers(Employee)
+
+if __name__ == "__main__":
+    app.run()

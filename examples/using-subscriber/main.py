@@ -1,0 +1,50 @@
+"""Subscriber example — batch inference off a pub/sub topic
+(BASELINE.md config 4; reference parity: examples/using-subscriber).
+
+Consumes image payloads from topic ``images``, classifies through the TPU
+executor (data-parallel over the mesh when ``TPU_MESH`` is set, e.g.
+``TPU_MESH=dp:8`` on a v5e-8 — replica-group execution over ICI), and
+publishes results to ``labels``. Commit-on-success: the message offset is
+committed only after the model call succeeds.
+
+Config via env: PUBSUB_BACKEND=KAFKA PUBSUB_BROKER=localhost:9092
+(or PUBSUB_BACKEND=INMEM for a self-contained demo).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from gofr_tpu import new_app
+
+
+async def on_image(ctx):
+    payload = ctx.bind()
+    image = np.asarray(payload["image"], np.float32)
+    logits = await ctx.predict("resnet50", image)
+    label = int(np.argmax(logits))
+    ctx.publish("labels", json.dumps(
+        {"id": payload.get("id"), "label": label}).encode())
+    ctx.logger.info("classified image %s -> %d", payload.get("id"), label)
+
+
+def build_app():
+    import jax
+
+    from gofr_tpu.models import resnet
+
+    app = new_app()
+    preset = os.environ.get("RESNET_PRESET", "50")
+    cfg = resnet.config(preset)
+    params = resnet.init(cfg, jax.random.PRNGKey(0))
+    app.add_model("resnet50", lambda p, x: resnet.apply(p, cfg, x),
+                  params=params, buckets=(8, 32, 64))
+    app.subscribe("images", on_image)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,23 @@
+"""WebSocket example — parity with reference examples/using-web-socket:
+echo + broadcast via the connection hub."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_app
+from gofr_tpu.websocket import hub
+
+
+async def chat(ctx):
+    await ctx.write_message({"system": "welcome"})
+    while True:
+        message = await ctx.read_message()
+        await hub().broadcast({"message": message})
+
+
+app = new_app()
+app.websocket("/chat", chat)
+
+if __name__ == "__main__":
+    app.run()

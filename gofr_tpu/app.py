@@ -223,13 +223,14 @@ class App:
         self.container.add_http_service(name, service)
 
     # -- TPU model registration (north star) --------------------------------
-    def add_model(self, name: str, model, **kwargs) -> None:
-        """Register a servable model with the container's TPU executor."""
+    def add_model(self, name: str, fn, params=None, **kwargs) -> None:
+        """Register a servable model (``fn(params, batch)``) with the
+        container's TPU executor, creating the executor on first use."""
         if self.container.tpu is None:
             from gofr_tpu.tpu import new_executor
             self.container.tpu = new_executor(self.config, self.logger,
                                               self.container.metrics)
-        self.container.tpu.register(name, model, **kwargs)
+        self.container.tpu.register(name, fn, params, **kwargs)
 
     # -- dispatch -----------------------------------------------------------
     async def _dispatch(self, request: Request):

@@ -10,6 +10,7 @@ from gofr_tpu.parallel.sharding import (
     bert_param_specs,
     llama_cache_specs,
     llama_param_specs,
+    prune_specs,
     replicated_specs,
     shard_pytree,
 )
@@ -18,6 +19,6 @@ from gofr_tpu.parallel.train import TrainState, make_eval_step, make_train_step
 __all__ = [
     "make_mesh", "serving_mesh", "ring_attention",
     "batch_spec", "bert_param_specs", "llama_cache_specs",
-    "llama_param_specs", "replicated_specs", "shard_pytree",
+    "llama_param_specs", "prune_specs", "replicated_specs", "shard_pytree",
     "TrainState", "make_eval_step", "make_train_step",
 ]

@@ -1,0 +1,123 @@
+"""Example apps boot and serve — reference style (examples/*/main_test.go:
+start the real app, fire real requests; SURVEY.md §4)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from tests.util import http_request, run, serving
+
+
+def _load_example(name, env=None):
+    for key, value in (env or {}).items():
+        os.environ[key] = value
+    path = os.path.join(os.path.dirname(__file__), "..", "examples", name,
+                        "main.py")
+    spec = importlib.util.spec_from_file_location(
+        f"example_{name.replace('-', '_')}", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _zero_ports(app):
+    app.http_port = 0
+    app.metrics_port = 0
+    app.grpc_port = 0
+    return app
+
+
+def test_http_server_example_hello_and_classify():
+    module = _load_example("http-server", {"RESNET_PRESET": "tiny"})
+
+    async def main():
+        app = _zero_ports(module.build_app())
+        async with serving(app) as port:
+            hello = await http_request(port, "GET", "/hello?name=TPU")
+            assert hello.json()["data"]["message"] == "Hello TPU!"
+            image = np.zeros((32, 32, 3), np.float32).tolist()
+            result = await http_request(
+                port, "POST", "/classify",
+                body=json.dumps({"image": image}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert result.status == 201
+            assert "label" in result.json()["data"]
+    run(main())
+
+
+def test_grpc_server_example_embeddings():
+    import grpc
+    module = _load_example("grpc-server", {"BERT_PRESET": "tiny"})
+
+    async def main():
+        app = _zero_ports(module.build_app())
+        await app.start()
+        try:
+            port = app._grpc_server.bound_port
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                method = ch.unary_unary("/gofr.Embeddings/embed")
+                raw = await method(json.dumps(
+                    {"token_ids": [1, 2, 3]}).encode())
+                embedding = json.loads(raw)["data"]["embedding"]
+                assert len(embedding) == 64  # tiny preset dim
+        finally:
+            await app.stop()
+    run(main())
+
+
+def test_subscriber_example_classifies_and_publishes():
+    module = _load_example("using-subscriber", {
+        "RESNET_PRESET": "tiny", "PUBSUB_BACKEND": "INMEM"})
+
+    async def main():
+        import asyncio
+        app = _zero_ports(module.build_app())
+        assert "images" in app._subscriptions
+        await app.start()
+        try:
+            image = np.zeros((32, 32, 3), np.float32).tolist()
+            app.container.pubsub.publish(
+                "images", json.dumps({"id": "a", "image": image}).encode())
+            result = await asyncio.wait_for(
+                app.container.pubsub.subscribe("labels"), 10.0)
+            assert json.loads(result.value)["id"] == "a"
+        finally:
+            await app.stop()
+    run(main())
+
+
+def test_llama_generate_example():
+    module = _load_example("llama-generate", {
+        "LLAMA_PRESET": "tiny", "MAX_NEW_TOKENS": "4"})
+
+    async def main():
+        app = _zero_ports(module.build_app())
+        async with serving(app) as port:
+            result = await http_request(
+                port, "POST", "/generate",
+                body=json.dumps({"prompt": "hi"}).encode(),
+                headers={"Content-Type": "application/json"})
+            data = result.json()["data"]
+            assert len(data["tokens"]) == 4
+            assert isinstance(data["completion"], str)
+    run(main())
+
+
+def test_cmd_example_hello():
+    from gofr_tpu.cli import run_cli
+    module = _load_example("cmd")
+    import io
+    out = io.StringIO()
+    assert run_cli(module.app, ["hello", "-name=cli"], stdout=out) == 0
+    assert "Hello cli!" in out.getvalue()
+
+
+def test_migrations_example_boots():
+    module = _load_example("using-migrations")
+    rows = module.app.container.sql.select("SELECT * FROM employee")
+    assert rows[0]["name"] == "ada"
+    assert module.app.container.redis.get("employee:seeded") == "true"
