@@ -49,6 +49,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # pallas flash-attention prefill (ops/pallas): O(S) memory, causal-block
+    # skipping — required beyond ~8K context on one core; falls back to the
+    # dense einsum when shapes don't meet TPU tiling constraints
+    use_flash: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -145,6 +149,9 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
         def attend(q, k, v):
             return ring_attention(q, k, v, mesh, axis_name=sp_axis,
                                   batch_axis=dp_axis, head_axis=tp_axis)
+    elif cfg.use_flash:
+        from gofr_tpu.ops.pallas import flash_attention
+        attend = flash_attention
     else:
         attend = prefill_attention
 
@@ -171,12 +178,16 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     x = params["tok_emb"][tokens]
+    if cfg.use_flash:
+        from gofr_tpu.ops.pallas import flash_attention as attend
+    else:
+        attend = prefill_attention
 
     def body(x, layer_and_cache):
         layer, k_cache, v_cache = layer_and_cache
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
-        attn = prefill_attention(q, k, v).reshape(b, s, -1)
+        attn = attend(q, k, v).reshape(b, s, -1)
         x = x + attn @ layer["wo"]
         h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
         x = x + _ffn(layer, h)

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the hot ops (see pallas_guide.md)."""
+
+from gofr_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]
