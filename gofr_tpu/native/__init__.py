@@ -1,0 +1,61 @@
+"""Native runtime components, built on demand with the system toolchain.
+
+The build is a single ``g++ -O2 -shared`` invocation cached next to the
+source (rebuilt when the .cpp is newer). Consumers must treat
+``load_tokenizer_lib() is None`` as "use the Python fallback" — the
+framework never hard-requires the toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _build(src: str, out: str) -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", out],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load_tokenizer_lib():
+    """ctypes handle to the BPE tokenizer library, or None."""
+    with _LOCK:
+        if "tokenizer" in _CACHE:
+            return _CACHE["tokenizer"]
+        src = os.path.join(_HERE, "tokenizer.cpp")
+        lib_path = os.path.join(_HERE, "_tokenizer.so")
+        if (not os.path.exists(lib_path)
+                or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+            if not _build(src, lib_path):
+                _CACHE["tokenizer"] = None
+                return None
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError:
+            _CACHE["tokenizer"] = None
+            return None
+        lib.gofr_tok_new.restype = ctypes.c_void_p
+        lib.gofr_tok_new.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.c_int32]
+        lib.gofr_tok_encode.restype = ctypes.c_int32
+        lib.gofr_tok_encode.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.gofr_tok_decode.restype = ctypes.c_int32
+        lib.gofr_tok_decode.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+        lib.gofr_tok_free.argtypes = [ctypes.c_void_p]
+        _CACHE["tokenizer"] = lib
+        return lib

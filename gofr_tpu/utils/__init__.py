@@ -1,0 +1,11 @@
+"""Utilities: checkpoint/resume for model + optimizer pytrees."""
+
+from gofr_tpu.utils.checkpoint import (
+    checkpoint_metadata,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = ["checkpoint_metadata", "latest_step", "restore_checkpoint",
+           "save_checkpoint"]

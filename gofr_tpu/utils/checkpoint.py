@@ -1,0 +1,131 @@
+"""Checkpoint/resume for param/optimizer pytrees.
+
+The reference's checkpoint analog is its migration journal (SURVEY.md §5:
+versioned, journaled, resumes from max(version)); this is the model-side
+equivalent: versioned step directories with atomic publish and
+latest-step resolution, so a serving process or training loop resumes
+exactly where it stopped.
+
+Format: one ``arrays.npz`` (flattened leaves, keyed by pytree path) +
+``tree.json`` (structure, dtypes, step metadata). Restoring onto a mesh:
+pass ``sharding`` (a pytree of NamedShardings or one for all) and leaves
+are device_put directly to their shards — the host never materialises more
+than one leaf at a time beyond numpy's mmap window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _flatten(tree: Any) -> Dict[str, Any]:
+    import jax
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = leaf
+    return flat
+
+
+def save_checkpoint(directory: str, tree: Any, step: int = 0,
+                    metadata: Optional[Dict[str, Any]] = None) -> str:
+    """Write ``directory/step_N`` atomically (tmpdir + rename). Returns the
+    checkpoint path."""
+    import jax
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, f"step_{step}")
+    flat = {key: np.asarray(leaf) for key, leaf in _flatten(tree).items()}
+    structure = jax.tree.structure(tree)
+    # numpy's npz can't round-trip ml_dtypes extension types (bfloat16,
+    # fp8): store them as same-width unsigned views, record the real dtype
+    dtypes = {key: str(value.dtype) for key, value in flat.items()}
+    stored = {}
+    for key, value in flat.items():
+        if value.dtype.name not in np.sctypeDict:
+            value = value.view({1: np.uint8, 2: np.uint16,
+                                4: np.uint32}[value.dtype.itemsize])
+        stored[key] = value
+    tmp = tempfile.mkdtemp(dir=directory, prefix=".tmp_ckpt_")
+    try:
+        np.savez(os.path.join(tmp, "arrays.npz"), **stored)
+        with open(os.path.join(tmp, "tree.json"), "w") as handle:
+            json.dump({
+                "keys": list(flat.keys()),
+                "treedef": str(structure),
+                "dtypes": dtypes,
+                "step": step,
+                "metadata": metadata or {},
+            }, handle)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return final
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = [int(name[5:]) for name in os.listdir(directory)
+             if name.startswith("step_") and name[5:].isdigit()]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory: str, like: Any, step: Optional[int] = None,
+                       sharding: Any = None) -> Any:
+    """Restore into the structure of ``like`` (a pytree of arrays or
+    ShapeDtypeStructs). ``step=None`` → latest. ``sharding``: one sharding
+    for every leaf or a matching pytree of shardings."""
+    import jax
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(directory, f"step_{step}")
+    with open(os.path.join(path, "tree.json")) as handle:
+        saved_dtypes = json.load(handle)["dtypes"]
+    with np.load(os.path.join(path, "arrays.npz")) as archive:
+        flat_like = _flatten(like)
+        leaves = {}
+        shard_tree = None
+        if sharding is not None:
+            is_single = not isinstance(sharding, (dict, list, tuple)) \
+                and not hasattr(sharding, "keys")
+            shard_tree = _flatten(sharding) if not is_single else None
+        for key, leaf_like in flat_like.items():
+            if key not in archive:
+                raise KeyError(f"checkpoint missing leaf {key!r}")
+            value = archive[key]
+            saved_dtype = saved_dtypes.get(key)
+            if saved_dtype and str(value.dtype) != saved_dtype:
+                import ml_dtypes  # ships with jax
+                value = value.view(np.dtype(getattr(ml_dtypes, saved_dtype,
+                                                    saved_dtype)))
+            dtype = getattr(leaf_like, "dtype", None)
+            if dtype is not None and str(dtype) != str(value.dtype):
+                value = value.astype(dtype)
+            if sharding is not None:
+                shard = shard_tree[key] if shard_tree is not None \
+                    else sharding
+                value = jax.device_put(value, shard)
+            leaves[key] = value
+    treedef = jax.tree.structure(like)
+    ordered = [leaves[key] for key in flat_like.keys()]
+    return jax.tree.unflatten(treedef, ordered)
+
+
+def checkpoint_metadata(directory: str,
+                        step: Optional[int] = None) -> Dict[str, Any]:
+    if step is None:
+        step = latest_step(directory)
+    with open(os.path.join(directory, f"step_{step}", "tree.json")) as f:
+        return json.load(f)

@@ -1,0 +1,57 @@
+"""BPE tokenizer tests: trainer, roundtrip, native-vs-python equivalence."""
+
+import pytest
+
+from gofr_tpu.tokenizer import Tokenizer
+
+CORPUS = ["the quick brown fox jumps over the lazy dog",
+          "the quick brown fox", "jump the dog", "lazy lazy lazy"] * 4
+
+
+@pytest.fixture(scope="module")
+def tokenizer():
+    return Tokenizer.train(CORPUS, vocab_size=300)
+
+
+def test_train_learns_merges(tokenizer):
+    assert tokenizer.vocab_size > 256
+    ids = tokenizer.encode("the quick brown fox")
+    # compression: merges actually fire
+    assert len(ids) < len("the quick brown fox")
+
+
+def test_roundtrip_identity(tokenizer):
+    for text in ["the lazy dog", "completely unseen zebra!", "",
+                 "unicode: héllo ☃"]:
+        assert tokenizer.decode(tokenizer.encode(text)) == text
+
+
+def test_native_matches_python(tokenizer):
+    if tokenizer._native is None:
+        pytest.skip("native toolchain unavailable")
+    for text in CORPUS + ["unseen text with ☃ and digits 123"]:
+        raw = text.encode()
+        assert tokenizer._encode_native(raw) == \
+            tokenizer._encode_python(raw), text
+
+
+def test_save_load_roundtrip(tokenizer, tmp_path):
+    path = str(tmp_path / "tok.json")
+    tokenizer.save(path)
+    loaded = Tokenizer.load(path)
+    assert loaded.merges == tokenizer.merges
+    text = "the quick brown fox"
+    assert loaded.encode(text) == tokenizer.encode(text)
+
+
+def test_bytes_only_tokenizer():
+    plain = Tokenizer()
+    assert plain.vocab_size == 256
+    assert plain.encode("ab") == [97, 98]
+    assert plain.decode([97, 98]) == "ab"
+
+
+def test_native_library_builds():
+    from gofr_tpu.native import load_tokenizer_lib
+    assert load_tokenizer_lib() is not None, \
+        "g++ is in the image; native build must succeed"
