@@ -31,8 +31,6 @@ def new_pubsub(backend: str, config, logger, metrics) -> PubSub:
         from gofr_tpu.datasource.pubsub.kafka import KafkaClient
         return KafkaClient(config, logger, metrics)
     if backend == "GOOGLE":
-        raise RuntimeError(
-            "GOOGLE pub/sub backend requires google-cloud-pubsub, which is "
-            "not available in this image; use KAFKA, MQTT, or INMEM"
-        )
+        from gofr_tpu.datasource.pubsub.google import GoogleClient
+        return GoogleClient(config, logger, metrics)
     raise ValueError(f"unknown PUBSUB_BACKEND {backend!r}")

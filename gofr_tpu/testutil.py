@@ -1,0 +1,35 @@
+"""Test helpers for user applications.
+
+Capability parity with ``pkg/gofr/testutil`` (os.go:8-40
+StdoutOutputForFunc/StderrOutputForFunc pipe-capture; error.go CustomError).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import sys
+from typing import Callable
+
+
+def stdout_output_for_func(func: Callable[[], None]) -> str:
+    """Run ``func`` and return everything it printed to stdout."""
+    buffer = io.StringIO()
+    with contextlib.redirect_stdout(buffer):
+        func()
+    return buffer.getvalue()
+
+
+def stderr_output_for_func(func: Callable[[], None]) -> str:
+    buffer = io.StringIO()
+    with contextlib.redirect_stderr(buffer):
+        func()
+    return buffer.getvalue()
+
+
+class CustomError(Exception):
+    """Deterministic error for assertions (testutil/error.go)."""
+
+    def __init__(self, message: str = "custom error"):
+        super().__init__(message)
+        self.message = message

@@ -276,3 +276,66 @@ def test_service_health_check(mock_container, upstream):
     bad = new_http_service("http://127.0.0.1:1", None, None, None,
                            timeout=0.2)
     assert bad.health_check()["status"] == "DOWN"
+
+
+# -- file utils / testutil / google gating -----------------------------------
+
+def test_unzip_with_bomb_guard(tmp_path):
+    import io
+    import zipfile
+
+    from gofr_tpu.file_utils import ZipBombError, unzip_bytes, unzip_to_dir
+
+    blob = io.BytesIO()
+    with zipfile.ZipFile(blob, "w") as archive:
+        archive.writestr("a.txt", "hello")
+        archive.writestr("dir/b.txt", "world")
+    data = blob.getvalue()
+    files = unzip_bytes(data)
+    assert files == {"a.txt": b"hello", "dir/b.txt": b"world"}
+    assert unzip_to_dir(data, str(tmp_path)) == 2
+    assert (tmp_path / "dir" / "b.txt").read_bytes() == b"world"
+
+    with pytest.raises(ZipBombError):
+        unzip_bytes(data, max_bytes=3)
+
+    evil = io.BytesIO()
+    with zipfile.ZipFile(evil, "w") as archive:
+        archive.writestr("../escape.txt", "x")
+    with pytest.raises(ZipBombError):
+        unzip_bytes(evil.getvalue())
+
+
+def test_testutil_capture_helpers():
+    from gofr_tpu.testutil import (
+        CustomError,
+        stderr_output_for_func,
+        stdout_output_for_func,
+    )
+
+    assert stdout_output_for_func(lambda: print("out")) == "out\n"
+    assert "err" in stderr_output_for_func(
+        lambda: print("err", file=__import__("sys").stderr))
+    assert str(CustomError("boom")) == "boom"
+
+
+def test_google_pubsub_gated(mock_container):
+    from gofr_tpu.datasource.pubsub import new_pubsub
+    with pytest.raises(Exception) as excinfo:
+        new_pubsub("GOOGLE", MapConfig({"GOOGLE_PROJECT_ID": "p"}),
+                   mock_container.logger, mock_container.metrics)
+    assert "google-cloud-pubsub" in str(excinfo.value)
+
+
+def test_file_row_readers(tmp_path, mock_container):
+    fs = mock_container.file
+    json_path = str(tmp_path / "rows.json")
+    with open(json_path, "w") as handle:
+        json.dump([{"a": 1}, {"a": 2}], handle)
+    rows = list(fs.read_all(json_path))
+    assert rows == [{"a": 1}, {"a": 2}]
+    csv_path = str(tmp_path / "rows.csv")
+    with open(csv_path, "w") as handle:
+        handle.write("x,y\n1,2\n3,4\n")
+    rows = list(fs.read_all(csv_path))
+    assert rows[0]["x"] == "1" and rows[1]["y"] == "4"
