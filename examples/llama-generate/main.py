@@ -1,10 +1,14 @@
-"""Llama /generate endpoint — tensor-parallel serving with HBM KV cache
+"""Llama /generate endpoint — continuous-batching serving with HBM KV cache
 (BASELINE.md config 5).
 
-``TPU_MESH=dp:1,tp:8`` shards the model Megatron-style over a v5e-8 slice
-(column/row-parallel param specs; XLA inserts the all-reduces over ICI).
-Uses the byte-level tokenizer so the demo is dependency-free; production
-swaps in a real SentencePiece vocab via the same params layout.
+Serving engine: slot-based continuous batching (gofr_tpu.tpu.GenerationEngine)
+— concurrent requests share decode steps; prompts prefill into per-slot KV
+cache regions without recompiles. Uses the framework BPE tokenizer (C++
+encode path when the toolchain is present).
+
+For tensor parallelism over a slice set ``TPU_MESH=dp:1,tp:8`` and shard
+params with gofr_tpu.parallel.llama_param_specs before building the engine
+(Megatron column/row specs; XLA inserts the all-reduces over ICI).
 
 POST /generate {"prompt": "...", "max_new_tokens": 32}
 """
@@ -13,52 +17,48 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
-import numpy as np
-
 from gofr_tpu import new_app
+from gofr_tpu.tokenizer import Tokenizer
 
 
 def build_app():
     import jax
-    import jax.numpy as jnp
 
     from gofr_tpu.models import llama
-    from gofr_tpu.parallel import llama_param_specs, prune_specs
+    from gofr_tpu.tpu import GenerationEngine
 
     app = new_app()
     preset = os.environ.get("LLAMA_PRESET", "small")
-    max_new = int(os.environ.get("MAX_NEW_TOKENS", "32"))
     cfg = llama.config(preset, vocab_size=256)  # byte-level vocab
     params = llama.init(cfg, jax.random.PRNGKey(0))
 
-    executor = None
-
-    def generate_fn(params, tokens):
-        return llama.generate(params, cfg, tokens, max_new)
-
-    specs = None
     if app.config.get("TPU_MESH"):
-        from gofr_tpu.tpu import new_executor
-        executor = new_executor(app.config, app.logger,
-                                app.container.metrics)
-        specs = prune_specs(llama_param_specs(), executor.mesh)
-        app.container.tpu = executor
-        executor.register("llama", generate_fn, params,
-                          buckets=(1, 2, 4, 8), param_specs=specs)
-    else:
-        app.add_model("llama", generate_fn, params, buckets=(1, 2, 4, 8))
+        from gofr_tpu.parallel import (
+            llama_param_specs, make_mesh, prune_specs, shard_pytree)
+        axes = {}
+        for part in str(app.config.get("TPU_MESH")).split(","):
+            axis, _, size = part.partition(":")
+            axes[axis.strip()] = int(size)
+        mesh = make_mesh(axes)
+        params = shard_pytree(params, mesh,
+                              prune_specs(llama_param_specs(), mesh))
 
-    prompt_len = 64
+    tokenizer = Tokenizer()  # byte-level; swap in a trained vocab via load()
+    engine = GenerationEngine(
+        cfg, params,
+        max_slots=int(os.environ.get("GENERATE_SLOTS", "8")),
+        max_len=min(cfg.max_seq_len, 1024),
+        logger=app.logger, metrics=app.container.metrics)
+    app.container.tpu = engine  # surfaces engine health under /.well-known
 
     async def generate(ctx):
+        await engine.start()  # idempotent; binds to the serving loop
         data = ctx.bind()
-        raw = data["prompt"].encode()[:prompt_len]
-        tokens = np.zeros((prompt_len,), np.int32)
-        tokens[-len(raw):] = list(raw)  # left-pad so last token is real
-        out = await ctx.predict("llama", tokens)
-        text = bytes(int(t) % 256 for t in out).decode("latin-1")
-        return {"completion": text,
-                "tokens": [int(t) for t in out]}
+        prompt_ids = tokenizer.encode(data["prompt"])[-512:]
+        max_new = int(data.get("max_new_tokens", 32))
+        out = await engine.generate(prompt_ids, max_new_tokens=max_new)
+        return {"completion": tokenizer.decode(out),
+                "tokens": out, "engine": engine.stats()}
 
     app.post("/generate", generate)
     return app

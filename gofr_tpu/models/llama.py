@@ -170,10 +170,17 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
 
 
 def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
-            cache: Dict[str, jnp.ndarray]
+            cache: Dict[str, jnp.ndarray],
+            lengths: Optional[jnp.ndarray] = None
             ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
     """Run the prompt, fill the cache. Returns (last-token logits (B, V),
-    cache, cache_len (B,))."""
+    cache, cache_len (B,)).
+
+    ``lengths`` (B,) supports right-padded prompts (the bucketed serving
+    path): logits are taken at position lengths-1 per sequence and
+    cache_len = lengths, so junk positions past a prompt's real end are
+    never attended to in decode.
+    """
     b, s = tokens.shape
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -197,9 +204,14 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
 
     x, (k_new, v_new) = lax.scan(body, x, (params["layers"],
                                            cache["k"], cache["v"]))
-    x = rms_norm(x[:, -1], params["out_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
-    cache_len = jnp.full((b,), s, jnp.int32)
+    if lengths is None:
+        last = x[:, -1]
+        cache_len = jnp.full((b,), s, jnp.int32)
+    else:
+        last = x[jnp.arange(b), lengths - 1]
+        cache_len = lengths.astype(jnp.int32)
+    last = rms_norm(last, params["out_norm"], cfg.norm_eps)
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": k_new, "v": v_new}, cache_len
 
 

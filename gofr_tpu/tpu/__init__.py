@@ -1,9 +1,11 @@
 """TPU executor datasource: compiled-model serving with bucketed AOT
-compilation, dynamic batching, per-chip health (north star, BASELINE.json).
-The identical executor runs on the CPU backend in tests — the "miniredis
-of XLA" strategy (SURVEY.md §4)."""
+compilation, dynamic batching, continuous-batching generation, per-chip
+health (north star, BASELINE.json). The identical executor runs on the
+CPU backend in tests — the "miniredis of XLA" strategy (SURVEY.md §4)."""
 
 from gofr_tpu.tpu.batcher import DynamicBatcher
 from gofr_tpu.tpu.executor import DEFAULT_BUCKETS, Executor, new_executor
+from gofr_tpu.tpu.generate import GenerationEngine
 
-__all__ = ["DynamicBatcher", "Executor", "new_executor", "DEFAULT_BUCKETS"]
+__all__ = ["DynamicBatcher", "Executor", "GenerationEngine", "new_executor",
+           "DEFAULT_BUCKETS"]

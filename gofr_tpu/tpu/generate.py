@@ -1,0 +1,249 @@
+"""Continuous-batching generation engine for the Llama /generate path.
+
+North star config 5 (BASELINE.json): "Llama-2-7B /generate ... KV-cache in
+HBM ... continuous batching on the generate loop" (SURVEY.md §7.7). The
+design is slot-based continuous batching:
+
+- One static-shape KV cache of ``max_slots`` sequences lives in HBM for
+  the engine's lifetime (no per-request allocation).
+- A new request claims a free slot: its prompt is right-padded to a
+  compiled length bucket and prefilled *into that slot* of the big cache
+  (one compiled prefill executable per bucket).
+- A single decode executable advances ALL active slots one token per tick
+  — requests join and leave mid-flight without recompiles or barriers,
+  so decode MXU work is amortised across every concurrent request.
+- Per-slot host state (remaining budget, eos, emitted tokens) stays in
+  numpy; device state is just (cache, cache_len, last_token).
+
+Everything here is single-executable static-shape XLA: the engine never
+traces after warmup.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+DEFAULT_PROMPT_BUCKETS = (32, 128, 512)
+
+
+class _Slot:
+    __slots__ = ("future", "remaining", "eos_id", "tokens", "active")
+
+    def __init__(self):
+        self.future: Optional[asyncio.Future] = None
+        self.remaining = 0
+        self.eos_id: Optional[int] = None
+        self.tokens: List[int] = []
+        self.active = False
+
+
+class GenerationEngine:
+    def __init__(self, cfg, params, max_slots: int = 8,
+                 max_len: Optional[int] = None,
+                 prompt_buckets=DEFAULT_PROMPT_BUCKETS,
+                 logger=None, metrics=None):
+        import jax
+        import jax.numpy as jnp
+
+        from gofr_tpu.models import llama
+
+        self._jax = jax
+        self._jnp = jnp
+        self._llama = llama
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.prompt_buckets = tuple(
+            b for b in sorted(prompt_buckets) if b <= self.max_len)
+        self.logger = logger
+        self.metrics = metrics
+
+        self.params = jax.device_put(params)
+        self.cache = jax.device_put(
+            llama.init_cache(cfg, max_slots, self.max_len))
+        self.cache_len = jnp.zeros((max_slots,), jnp.int32)
+        self.last_token = jnp.zeros((max_slots,), jnp.int32)
+
+        self._slots = [_Slot() for _ in range(max_slots)]
+        self._free: List[int] = list(range(max_slots))
+        self._pending: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._steps = 0
+
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode_fn = None
+
+    # -- compiled steps -----------------------------------------------------
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
+                                    self.cfg)
+
+            def prefill_slot(params, tokens, length, cache, slot):
+                """tokens (1, bucket) right-padded; scatter the slot's KV."""
+                small = llama.init_cache(cfg, 1, self.max_len)
+                logits, small, _ = llama.prefill(
+                    params, cfg, tokens, small, lengths=length)
+                new_cache = {
+                    "k": cache["k"].at[:, slot].set(small["k"][:, 0]),
+                    "v": cache["v"].at[:, slot].set(small["v"][:, 0]),
+                }
+                return logits[0], new_cache
+
+            fn = jax.jit(prefill_slot, donate_argnums=(3,))
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode(self):
+        if self._decode_fn is None:
+            jax, llama, cfg = self._jax, self._llama, self.cfg
+
+            def decode_all(params, token, cache, cache_len):
+                logits, cache, cache_len = llama.decode_step(
+                    params, cfg, token, cache, cache_len)
+                next_token = logits.argmax(axis=-1).astype(token.dtype)
+                return next_token, cache, cache_len
+
+            self._decode_fn = jax.jit(decode_all, donate_argnums=(2,))
+        return self._decode_fn
+
+    # -- public API ---------------------------------------------------------
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def generate(self, prompt_ids, max_new_tokens: int,
+                       eos_id: Optional[int] = None) -> List[int]:
+        """Generate up to ``max_new_tokens`` ids (stops early on eos_id).
+        Concurrent callers share decode steps (continuous batching)."""
+        prompt = list(int(t) for t in prompt_ids)
+        bucket = next((b for b in self.prompt_buckets if b >= len(prompt)),
+                      None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds largest bucket "
+                f"{self.prompt_buckets[-1]}")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError("prompt + max_new_tokens exceeds cache length")
+        future = asyncio.get_running_loop().create_future()
+        await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
+                                 future))
+        self._wake.set()
+        return await future
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for slot in self._slots if slot.active)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"active_slots": self.active_slots,
+                "free_slots": len(self._free),
+                "decode_steps": self._steps,
+                "max_len": self.max_len}
+
+    def health_check(self) -> Dict[str, Any]:
+        """Container-health contract (container/health.go analog)."""
+        details: Dict[str, Any] = dict(self.stats())
+        try:
+            for device in self._jax.devices():
+                memory = device.memory_stats() or {}
+                details.setdefault("devices", {})[str(device.id)] = {
+                    "hbm_bytes_in_use": memory.get("bytes_in_use", 0)}
+            status = "UP"
+        except Exception as exc:
+            details["error"] = repr(exc)
+            status = "DOWN"
+        return {"status": status, "details": details}
+
+    # -- engine loop --------------------------------------------------------
+    async def _loop(self) -> None:
+        jnp = self._jnp
+        np_token = np.zeros((self.max_slots,), np.int32)
+        while True:
+            # admit as many pending requests as there are free slots
+            while self._free and not self._pending.empty():
+                prompt, bucket, budget, eos_id, future = \
+                    self._pending.get_nowait()
+                slot_idx = self._free.pop()
+                slot = self._slots[slot_idx]
+                slot.future = future
+                slot.remaining = budget
+                slot.eos_id = eos_id
+                slot.tokens = []
+                slot.active = True
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._admit, slot_idx, prompt, bucket)
+                # prefill produced the first generated token
+                first = slot.tokens[0]
+                slot.remaining -= 1
+                if slot.remaining <= 0 or (slot.eos_id is not None
+                                           and first == slot.eos_id):
+                    slot.active = False
+                    self._free.append(slot_idx)
+                    if not future.done():
+                        future.set_result(list(slot.tokens))
+
+            if self.active_slots == 0:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+
+            # one decode tick for every active slot
+            next_token, self.cache, self.cache_len = await \
+                asyncio.get_running_loop().run_in_executor(
+                    None, self._decode_tick)
+            self._steps += 1
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_tpu_batch_size", float(self.active_slots),
+                    model="generate")
+            for slot_idx, slot in enumerate(self._slots):
+                if not slot.active:
+                    continue
+                token = int(next_token[slot_idx])
+                slot.tokens.append(token)
+                slot.remaining -= 1
+                done = (slot.remaining <= 0
+                        or (slot.eos_id is not None
+                            and token == slot.eos_id))
+                if done:
+                    slot.active = False
+                    self._free.append(slot_idx)
+                    if slot.future is not None and not slot.future.done():
+                        slot.future.set_result(list(slot.tokens))
+            self.last_token = jnp.asarray(next_token)
+
+    def _admit(self, slot_idx: int, prompt: List[int], bucket: int) -> None:
+        """Blocking prefill of one slot (runs in the executor thread)."""
+        jnp = self._jnp
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        length = jnp.asarray([len(prompt)], jnp.int32)
+        logits, self.cache = self._prefill_fn(bucket)(
+            self.params, jnp.asarray(padded), length, self.cache,
+            slot_idx)
+        first = int(np.asarray(logits).argmax())
+        self.last_token = self.last_token.at[slot_idx].set(first)
+        self.cache_len = self.cache_len.at[slot_idx].set(len(prompt))
+        slot = self._slots[slot_idx]
+        slot.tokens = [first]
+
+    def _decode_tick(self):
+        next_token, cache, cache_len = self._decode()(
+            self.params, self.last_token, self.cache, self.cache_len)
+        self._jax.block_until_ready(next_token)
+        return np.asarray(next_token), cache, cache_len

@@ -92,18 +92,20 @@ def test_subscriber_example_classifies_and_publishes():
 
 def test_llama_generate_example():
     module = _load_example("llama-generate", {
-        "LLAMA_PRESET": "tiny", "MAX_NEW_TOKENS": "4"})
+        "LLAMA_PRESET": "tiny", "GENERATE_SLOTS": "2"})
 
     async def main():
         app = _zero_ports(module.build_app())
         async with serving(app) as port:
             result = await http_request(
                 port, "POST", "/generate",
-                body=json.dumps({"prompt": "hi"}).encode(),
+                body=json.dumps({"prompt": "hi",
+                                 "max_new_tokens": 4}).encode(),
                 headers={"Content-Type": "application/json"})
             data = result.json()["data"]
             assert len(data["tokens"]) == 4
             assert isinstance(data["completion"], str)
+            assert data["engine"]["free_slots"] == 2
     run(main())
 
 
