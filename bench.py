@@ -61,6 +61,8 @@ def main() -> None:
     per_batch_relay = min(timed_window(u8_host, max(2, iters // 4))
                           for _ in range(2))
 
+    llama_tok_s = _llama_decode_bench(on_tpu)
+
     print(json.dumps({
         "metric": "resnet50_classify_throughput_per_chip",
         "value": round(req_per_s, 1),
@@ -70,7 +72,43 @@ def main() -> None:
         "batch": batch,
         "batch_latency_ms": round(per_batch * 1e3, 2),
         "value_with_relay_h2d": round(batch / per_batch_relay, 1),
+        "llama_small_decode_tok_s": llama_tok_s,
     }))
+
+
+def _llama_decode_bench(on_tpu: bool) -> float:
+    """Secondary metric: aggregate decode tok/s through the
+    continuous-batching engine (8 streams, llama-small, K=8 multi-step)."""
+    import asyncio
+
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    preset = "small" if on_tpu else "tiny"
+    cfg = llama.config(preset, max_seq_len=1024)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    container = new_mock_container()
+    engine = GenerationEngine(cfg, params, max_slots=8, max_len=512,
+                              prompt_buckets=(32,), steps_per_tick=8,
+                              logger=container.logger,
+                              metrics=container.metrics)
+    tokens_each = 64 if on_tpu else 8
+
+    async def run_streams():
+        await engine.start()
+        await engine.generate(list(range(8)), max_new_tokens=2)  # warm
+        start = time.perf_counter()
+        outs = await asyncio.gather(*[
+            engine.generate([i + 1] * 16, max_new_tokens=tokens_each)
+            for i in range(8)])
+        elapsed = time.perf_counter() - start
+        await engine.stop()
+        return sum(len(o) for o in outs) / elapsed
+
+    return round(asyncio.run(run_streams()), 1)
 
 
 if __name__ == "__main__":

@@ -44,6 +44,7 @@ class GenerationEngine:
     def __init__(self, cfg, params, max_slots: int = 8,
                  max_len: Optional[int] = None,
                  prompt_buckets=DEFAULT_PROMPT_BUCKETS,
+                 steps_per_tick: int = 1,
                  logger=None, metrics=None):
         import jax
         import jax.numpy as jnp
@@ -58,6 +59,10 @@ class GenerationEngine:
         self.max_len = max_len or cfg.max_seq_len
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.max_len)
+        # multi-step scheduling: K fused decode steps per host round trip
+        # (lax.scan inside one executable). Amortises dispatch/sync latency
+        # K-fold at the cost of ≤K-1 discarded tokens past an eos.
+        self.steps_per_tick = max(1, int(steps_per_tick))
         self.logger = logger
         self.metrics = metrics
 
@@ -102,12 +107,20 @@ class GenerationEngine:
     def _decode(self):
         if self._decode_fn is None:
             jax, llama, cfg = self._jax, self._llama, self.cfg
+            from jax import lax
+            steps = self.steps_per_tick
 
             def decode_all(params, token, cache, cache_len):
-                logits, cache, cache_len = llama.decode_step(
-                    params, cfg, token, cache, cache_len)
-                next_token = logits.argmax(axis=-1).astype(token.dtype)
-                return next_token, cache, cache_len
+                def one(carry, _):
+                    token, cache, cache_len = carry
+                    logits, cache, cache_len = llama.decode_step(
+                        params, cfg, token, cache, cache_len)
+                    next_token = logits.argmax(axis=-1).astype(token.dtype)
+                    return (next_token, cache, cache_len), next_token
+
+                (token, cache, cache_len), tokens = lax.scan(
+                    one, (token, cache, cache_len), None, length=steps)
+                return tokens, cache, cache_len   # tokens: (K, B)
 
             self._decode_fn = jax.jit(decode_all, donate_argnums=(2,))
         return self._decode_fn
@@ -202,8 +215,8 @@ class GenerationEngine:
                 await self._wake.wait()
                 continue
 
-            # one decode tick for every active slot
-            next_token, self.cache, self.cache_len = await \
+            # one decode tick: K fused steps for every active slot
+            tick_tokens, self.cache, self.cache_len = await \
                 asyncio.get_running_loop().run_in_executor(
                     None, self._decode_tick)
             self._steps += 1
@@ -214,18 +227,20 @@ class GenerationEngine:
             for slot_idx, slot in enumerate(self._slots):
                 if not slot.active:
                     continue
-                token = int(next_token[slot_idx])
-                slot.tokens.append(token)
-                slot.remaining -= 1
-                done = (slot.remaining <= 0
-                        or (slot.eos_id is not None
-                            and token == slot.eos_id))
-                if done:
-                    slot.active = False
-                    self._free.append(slot_idx)
-                    if slot.future is not None and not slot.future.done():
-                        slot.future.set_result(list(slot.tokens))
-            self.last_token = jnp.asarray(next_token)
+                for step in range(tick_tokens.shape[0]):
+                    token = int(tick_tokens[step, slot_idx])
+                    slot.tokens.append(token)
+                    slot.remaining -= 1
+                    if (slot.remaining <= 0
+                            or (slot.eos_id is not None
+                                and token == slot.eos_id)):
+                        slot.active = False   # rest of chunk discarded
+                        self._free.append(slot_idx)
+                        if slot.future is not None \
+                                and not slot.future.done():
+                            slot.future.set_result(list(slot.tokens))
+                        break
+            self.last_token = jnp.asarray(tick_tokens[-1])
 
     def _admit(self, slot_idx: int, prompt: List[int], bucket: int) -> None:
         """Blocking prefill of one slot (runs in the executor thread)."""

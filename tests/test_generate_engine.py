@@ -120,3 +120,25 @@ def test_rejects_oversized_prompts(setup):
         finally:
             await engine.stop()
     asyncio.run(main())
+
+
+def test_multi_step_scheduling_matches_reference(setup):
+    """steps_per_tick=4 fuses 4 decode steps per host round trip; output
+    must be identical to single-step (greedy is deterministic)."""
+    cfg, params = setup
+
+    async def main():
+        engine = _make_engine(cfg, params, steps_per_tick=4)
+        await engine.start()
+        try:
+            prompt = [1, 2, 3, 4]
+            out = await asyncio.wait_for(
+                engine.generate(prompt, max_new_tokens=7), 60.0)
+            ref = llama.generate(params, cfg,
+                                 np.asarray([prompt], np.int32), 7)
+            assert out == [int(t) for t in np.asarray(ref)[0]]
+            # 7 tokens: 1 from prefill + 6 decode → ceil(6/4)=2 ticks
+            assert engine.stats()["decode_steps"] == 2
+        finally:
+            await engine.stop()
+    asyncio.run(main())
