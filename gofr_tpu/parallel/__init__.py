@@ -4,6 +4,7 @@ training. TPU-native replacement for the reference's scale-out story
 XLA inserts collectives, traffic rides ICI."""
 
 from gofr_tpu.parallel.mesh import make_mesh, serving_mesh
+from gofr_tpu.parallel.pipeline import make_pp_forward
 from gofr_tpu.parallel.ring_attention import ring_attention
 from gofr_tpu.parallel.sharding import (
     batch_spec,
@@ -20,5 +21,5 @@ __all__ = [
     "make_mesh", "serving_mesh", "ring_attention",
     "batch_spec", "bert_param_specs", "llama_cache_specs",
     "llama_param_specs", "prune_specs", "replicated_specs", "shard_pytree",
-    "TrainState", "make_eval_step", "make_train_step",
+    "TrainState", "make_eval_step", "make_train_step", "make_pp_forward",
 ]

@@ -1,0 +1,121 @@
+"""Pipeline parallelism (pp): GPipe microbatch schedule over a mesh axis.
+
+Completes the framework's parallelism axes (dp/tp/sp/ep/pp). No reference
+analog (SURVEY.md §2.8). TPU-first shape:
+
+- The decoder's stacked layer weights (L, ...) are reshaped to
+  (PP, L/PP, ...) and the leading stage axis is sharded on ``pp`` inside
+  ``shard_map`` — each device holds only its stage's weights.
+- One ``lax.fori_loop`` runs M + PP - 1 ticks; per tick every rank applies
+  its stage (an inner ``lax.scan`` over its layer slice) and hands its
+  activation to the next rank via ``lax.ppermute`` — neighbour traffic on
+  ICI, exactly the transfer pattern pipeline stages want.
+- Rank 0 feeds embedded microbatches in; the last rank collects final
+  hidden states, which a ``psum`` (others contribute zeros) replicates so
+  the unembedding runs outside the shard_map.
+- Bubble overhead is the standard GPipe (PP-1)/(M+PP-1); raise the
+  microbatch count M to amortise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gofr_tpu.models import llama as llama_mod
+from gofr_tpu.ops import prefill_attention, rms_norm, rope_table
+
+
+def _split_stages(layers: Dict[str, jnp.ndarray], pp: int):
+    """(L, ...) stacked layer weights → (PP, L/PP, ...)."""
+    def reshape(leaf):
+        l_count = leaf.shape[0]
+        if l_count % pp:
+            raise ValueError(f"n_layers {l_count} not divisible by pp={pp}")
+        return leaf.reshape(pp, l_count // pp, *leaf.shape[1:])
+    return jax.tree.map(reshape, layers)
+
+
+def _stage_apply(stage_layers, x, cfg, cos, sin, positions):
+    """Apply this rank's slice of layers (scan over the local stack)."""
+    b, s, _ = x.shape
+
+    def body(x, layer):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = llama_mod._qkv(layer, h, cfg, cos, sin, positions)
+        attn = prefill_attention(q, k, v).reshape(b, s, -1)
+        x = x + attn @ layer["wo"]
+        h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+        x = x + llama_mod._ffn(layer, h)
+        return x, None
+
+    x, _ = lax.scan(body, x, stage_layers)
+    return x
+
+
+def make_pp_forward(cfg, mesh: Mesh, n_microbatches: int,
+                    axis: str = "pp"):
+    """Build ``fn(params, tokens) -> logits`` running the llama decoder as
+    a PP-stage pipeline. ``tokens`` (B, S) with B divisible by
+    n_microbatches; params are the standard llama pytree."""
+    pp = mesh.shape[axis]
+
+    def forward(params, tokens):
+        b, s = tokens.shape
+        m = n_microbatches
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by {m} microbatches")
+        bm = b // m
+        cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32),
+                                     (bm, s))
+        emb = params["tok_emb"][tokens].reshape(m, bm, s, cfg.dim)
+        stages = _split_stages(params["layers"], pp)
+
+        def ranked(stage_layers, emb):
+            rank = lax.axis_index(axis)
+            n = lax.axis_size(axis)
+            stage_layers = jax.tree.map(lambda l: l[0], stage_layers)
+            recv = jnp.zeros((bm, s, cfg.dim), emb.dtype)
+            collected = jnp.zeros((m, bm, s, cfg.dim), emb.dtype)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+
+            def tick(t, carry):
+                recv, collected = carry
+                feed = emb[jnp.minimum(t, m - 1)]
+                x_in = jnp.where(rank == 0, feed, recv)
+                x_out = _stage_apply(stage_layers, x_in, cfg, cos, sin,
+                                     positions)
+                micro = t - (n - 1)
+                take = (rank == n - 1) & (micro >= 0) & (micro < m)
+                collected = lax.cond(
+                    take,
+                    lambda c: c.at[jnp.clip(micro, 0, m - 1)].set(x_out),
+                    lambda c: c,
+                    collected)
+                recv = lax.ppermute(x_out, axis, perm)
+                return recv, collected
+
+            _, collected = lax.fori_loop(0, m + n - 1, tick,
+                                         (recv, collected))
+            # only the last rank holds real data; psum replicates it
+            contribution = jnp.where(rank == n - 1, collected,
+                                     jnp.zeros_like(collected))
+            return lax.psum(contribution, axis)
+
+        in_layer_specs = jax.tree.map(lambda _: P(axis), stages,
+                                      is_leaf=lambda x: hasattr(x, "shape"))
+        hidden = jax.shard_map(
+            ranked, mesh=mesh,
+            in_specs=(in_layer_specs, P()), out_specs=P(),
+            check_vma=False)(stages, emb)
+        hidden = hidden.reshape(b, s, cfg.dim)
+        hidden = rms_norm(hidden, params["out_norm"], cfg.norm_eps)
+        return (hidden @ params["lm_head"]).astype(jnp.float32)
+
+    return forward

@@ -68,6 +68,22 @@ def llama_cache_specs(dp: str = "dp", tp: str = "tp") -> Dict[str, P]:
     return {"k": spec, "v": spec}
 
 
+def moe_param_specs(tp: str = "tp", ep: str = "ep") -> Dict[str, Any]:
+    """PartitionSpecs for gofr_tpu.models.moe: expert-stacked FFN weights
+    (L, E, D, F) shard the expert axis on ``ep`` (GSPMD lowers the
+    dispatch einsum to an all-to-all over ICI); attention stays Megatron
+    tensor-parallel on ``tp``; routers replicate."""
+    specs = llama_param_specs(tp)
+    layers = dict(specs["layers"])
+    layers.pop("w_gate"), layers.pop("w_up"), layers.pop("w_down")
+    layers["router"] = P(None, None, None)
+    layers["w_gate"] = P(None, ep, None, tp)
+    layers["w_up"] = P(None, ep, None, tp)
+    layers["w_down"] = P(None, ep, tp, None)
+    specs["layers"] = layers
+    return specs
+
+
 def bert_param_specs(tp: str = "tp") -> Dict[str, Any]:
     """PartitionSpecs mirroring gofr_tpu.models.bert param pytree."""
     return {

@@ -104,3 +104,28 @@ def test_graft_entry_dryrun():
     fn, args = module.entry()
     out = jax.eval_shape(fn, *args)  # trace-only: compile check is driver's
     assert out.shape[-1] == 32000
+
+
+def test_pipeline_parallel_forward_exact():
+    """GPipe pp forward must be bit-identical to the plain decoder."""
+    from gofr_tpu.parallel.pipeline import make_pp_forward
+    cfg = llama.config("tiny", n_layers=4)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0,
+                                cfg.vocab_size)
+    ref = llama.forward(params, cfg, tokens)
+    for axes, micro in (({"pp": 4}, 2), ({"pp": 2, "dp": 2}, 4)):
+        mesh = make_mesh(axes)
+        out = jax.jit(make_pp_forward(cfg, mesh, n_microbatches=micro))(
+            params, tokens)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_pipeline_parallel_validates_divisibility():
+    from gofr_tpu.parallel.pipeline import make_pp_forward
+    cfg = llama.config("tiny", n_layers=3)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh({"pp": 2})
+    fn = make_pp_forward(cfg, mesh, n_microbatches=2)
+    with pytest.raises(ValueError):
+        fn(params, jnp.ones((4, 8), jnp.int32))
