@@ -190,6 +190,11 @@ class App:
         self._cli_commands.append(
             CLICommand(pattern, handler, description, help_text))
 
+    # -- profiler (no reference analog; profiler.py) ------------------------
+    def enable_profiler(self, prefix: str = "/debug/profiler") -> None:
+        from gofr_tpu.profiler import enable_profiler
+        enable_profiler(self, prefix)
+
     # -- external DB injection (externalDB.go:5-39) -------------------------
     def add_mongo(self, client=None) -> None:
         if client is None:

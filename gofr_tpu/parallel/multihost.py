@@ -1,0 +1,86 @@
+"""Multi-host distributed runtime: DCN × ICI hybrid meshes.
+
+TPU-native replacement for the reference's inter-node story (SURVEY.md
+§2.8: the Go reference scales out via Kafka consumer groups + k8s; tensor
+traffic here is delegated to XLA exactly as GoFr delegates broker IO to
+kafka-go). Within a slice, collectives ride ICI; across slices/hosts they
+ride DCN — so mesh axes must be laid out DCN-outermost, which is exactly
+what ``hybrid_mesh`` builds (mesh_utils.create_hybrid_device_mesh).
+
+Initialization follows the JAX multi-process model: every host runs the
+same program, ``initialize_distributed`` wires them via the coordinator
+address, and ``jax.devices()`` becomes the global slice view.
+Env contract (k8s-friendly, matching the framework's env-first config):
+  JAX_COORDINATOR=host:port  JAX_NUM_PROCESSES=N  JAX_PROCESS_ID=i
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh
+
+
+def initialize_distributed(config=None) -> bool:
+    """Initialize jax.distributed from env/config; returns True if a
+    multi-process runtime was actually started (single-host no-op)."""
+    def get(key: str, default: str = "") -> str:
+        if config is not None:
+            return config.get_or_default(key, default)
+        import os
+        return os.environ.get(key, default)
+
+    coordinator = get("JAX_COORDINATOR")
+    if not coordinator:
+        return False
+    num_processes = int(get("JAX_NUM_PROCESSES", "1"))
+    process_id = int(get("JAX_PROCESS_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def hybrid_mesh(ici_axes: Dict[str, int],
+                dcn_axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Mesh with DCN axes outermost and ICI axes innermost.
+
+    ``hybrid_mesh({"dp": 4, "tp": 2}, {"dp_outer": 2})`` on 2 hosts × 8
+    chips: data parallelism splits over DCN first (gradient all-reduce
+    crosses hosts once), while tp all-reduces stay on ICI. On a single
+    host every dcn axis must be 1 (validated).
+    """
+    from jax.experimental import mesh_utils
+
+    dcn_axes = dcn_axes or {}
+    num_slices = max(1, getattr(jax, "process_count", lambda: 1)())
+    dcn_total = 1
+    for size in dcn_axes.values():
+        dcn_total *= size
+    if dcn_total > num_slices:
+        raise ValueError(
+            f"dcn axes {dcn_axes} need {dcn_total} processes, have "
+            f"{num_slices}")
+
+    names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
+    ici_shape = tuple(ici_axes.values())
+    if dcn_axes and dcn_total > 1:
+        devices = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, tuple(dcn_axes.values()))
+    else:
+        # single host: dcn axes degenerate to 1, plain ICI mesh
+        devices = mesh_utils.create_device_mesh(ici_shape)
+        devices = devices.reshape((1,) * len(dcn_axes) + ici_shape)
+    return Mesh(devices, names,
+                axis_types=(jax.sharding.AxisType.Auto,) * len(names))
+
+
+def process_info() -> Dict[str, int]:
+    """This host's view of the job (for logs/health endpoints)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

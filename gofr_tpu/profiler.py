@@ -1,0 +1,47 @@
+"""On-demand XLA profiling over HTTP.
+
+The reference exposes no profiler (SURVEY.md §5 "no pprof endpoints");
+for a TPU serving process a trace is the first diagnostic, so the
+framework wires jax.profiler behind two admin routes:
+
+  POST /debug/profiler/start {"dir": "/tmp/trace"}   → starts a trace
+  POST /debug/profiler/stop                          → stops, returns dir
+
+The captured directory is TensorBoard/XProf-compatible. Routes are only
+registered via ``app.enable_profiler()`` — never on by default.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_state = {"dir": None}
+_lock = threading.Lock()
+
+
+def enable_profiler(app, prefix: str = "/debug/profiler") -> None:
+    def start(ctx):
+        import jax
+        body = ctx.bind() or {}
+        trace_dir = body.get("dir") or "/tmp/gofr_tpu_trace"
+        with _lock:
+            if _state["dir"] is not None:
+                return {"status": "already profiling",
+                        "dir": _state["dir"]}
+            jax.profiler.start_trace(trace_dir)
+            _state["dir"] = trace_dir
+        ctx.logger.info("profiler started -> %s", trace_dir)
+        return {"status": "started", "dir": trace_dir}
+
+    def stop(ctx):
+        import jax
+        with _lock:
+            if _state["dir"] is None:
+                return {"status": "not profiling"}
+            jax.profiler.stop_trace()
+            trace_dir, _state["dir"] = _state["dir"], None
+        ctx.logger.info("profiler stopped, trace in %s", trace_dir)
+        return {"status": "stopped", "dir": trace_dir}
+
+    app.post(f"{prefix}/start", start)
+    app.post(f"{prefix}/stop", stop)

@@ -1,0 +1,61 @@
+"""Multi-host mesh helpers + profiler endpoint tests (single-host paths)."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.parallel.multihost import (
+    hybrid_mesh,
+    initialize_distributed,
+    process_info,
+)
+from tests.util import http_request, make_app, run, serving
+
+
+def test_initialize_distributed_noop_without_coordinator():
+    assert initialize_distributed(MapConfig({})) is False
+
+
+def test_hybrid_mesh_single_host():
+    mesh = hybrid_mesh({"dp": 4, "tp": 2}, {"dp_outer": 1})
+    assert dict(mesh.shape) == {"dp_outer": 1, "dp": 4, "tp": 2}
+    # dcn axis present but degenerate: sharding over it is a no-op
+    mesh2 = hybrid_mesh({"dp": 8})
+    assert dict(mesh2.shape) == {"dp": 8}
+
+
+def test_hybrid_mesh_rejects_oversized_dcn():
+    with pytest.raises(ValueError):
+        hybrid_mesh({"dp": 4}, {"dp_outer": 2})  # only 1 process
+
+
+def test_process_info():
+    info = process_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] == 8
+
+
+def test_profiler_endpoints(tmp_path):
+    async def main():
+        app = make_app()
+        app.enable_profiler()
+        trace_dir = str(tmp_path / "trace")
+        async with serving(app) as port:
+            started = await http_request(
+                port, "POST", "/debug/profiler/start",
+                body=json.dumps({"dir": trace_dir}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert started.json()["data"]["status"] == "started"
+            # profiling something gives the trace real content
+            jax.block_until_ready(
+                jax.jit(lambda x: x * 2)(jax.numpy.ones((8, 8))))
+            stopped = await http_request(port, "POST",
+                                         "/debug/profiler/stop")
+            assert stopped.json()["data"]["status"] == "stopped"
+            assert os.path.isdir(trace_dir)
+            again = await http_request(port, "POST", "/debug/profiler/stop")
+            assert again.json()["data"]["status"] == "not profiling"
+    run(main())
