@@ -264,7 +264,10 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
             return jax.random.categorical(key, logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
-    first = sample(logits, rng).astype(jnp.int32)
+    # Split once up front: one key for the prefill sample, distinct fresh
+    # keys for the max_new_tokens-1 decode steps (never reuse a consumed key).
+    all_keys = jax.random.split(rng, max_new_tokens)
+    first = sample(logits, all_keys[0]).astype(jnp.int32)
 
     def body(carry, key):
         token, cache, cache_len = carry
@@ -273,7 +276,7 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
         next_token = sample(logits, key).astype(jnp.int32)
         return (next_token, cache, cache_len), token
 
-    keys = jax.random.split(rng, max_new_tokens)
+    keys = all_keys[1:]
     (last, _, _), out = lax.scan(body, (first, cache, cache_len),
                                  keys[:max_new_tokens - 1] if max_new_tokens > 1
                                  else keys[:0])

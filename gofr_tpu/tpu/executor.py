@@ -82,6 +82,12 @@ class Executor:
             params = shard_pytree(params, self.mesh, param_specs)
         else:
             params = jax.device_put(params)
+        if self.mesh is not None and self.batch_axis in self.mesh.shape:
+            # Every bucket must shard evenly over the dp axis —
+            # device_put with an uneven NamedSharding raises, so round the
+            # ladder up to multiples of the axis size (1,2,4,… → dp,2dp,…).
+            dp = self.mesh.shape[self.batch_axis]
+            buckets = sorted({-(-b // dp) * dp for b in buckets})
         jitted = jax.jit(fn)
         model = _Model(name, jitted, params, buckets)
         self._models[name] = model

@@ -63,9 +63,23 @@ def save_checkpoint(directory: str, tree: Any, step: int = 0,
                 "step": step,
                 "metadata": metadata or {},
             }, handle)
+        # Atomic publish even when overwriting: move the old copy aside
+        # first so a crash between the two renames leaves either the old
+        # or the new checkpoint in place, never neither.
+        old = None
         if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(tmp, final)
+            old = tempfile.mkdtemp(dir=directory, prefix=".old_ckpt_")
+            os.rmdir(old)
+            os.rename(final, old)
+        try:
+            os.rename(tmp, final)
+        except Exception:
+            if old is not None and not os.path.exists(final):
+                os.rename(old, final)  # roll the old checkpoint back in
+                old = None
+            raise
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
     except Exception:
         shutil.rmtree(tmp, ignore_errors=True)
         raise

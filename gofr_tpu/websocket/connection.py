@@ -20,9 +20,18 @@ from gofr_tpu.websocket.frames import (
     OP_PING,
     OP_PONG,
     OP_TEXT,
+    FrameTooLarge,
+    ProtocolError,
     decode_frame,
+    encode_close,
     encode_frame,
 )
+
+# One message (single frame or reassembled fragments) may not exceed this;
+# mirrors the HTTP path's body cap (http/server.py _MAX_BODY_BYTES ethos) so
+# a single client cannot exhaust server memory with a 2**63-byte declared
+# length or an endless fragment stream.
+DEFAULT_MAX_MESSAGE_BYTES = 16 * 1024 * 1024
 
 
 class ConnectionClosed(Exception):
@@ -32,7 +41,8 @@ class ConnectionClosed(Exception):
 class Connection:
     def __init__(self, transport, key: str, path: str,
                  path_params: Optional[Dict[str, str]] = None,
-                 query_params: Optional[Dict[str, List[str]]] = None):
+                 query_params: Optional[Dict[str, List[str]]] = None,
+                 max_message_bytes: int = DEFAULT_MAX_MESSAGE_BYTES):
         self.transport = transport
         self.key = key
         self.path = path
@@ -41,7 +51,9 @@ class Connection:
         self._buffer = bytearray()
         self._messages: asyncio.Queue = asyncio.Queue()
         self._fragments: List[bytes] = []
+        self._fragment_len = 0
         self._fragment_op = OP_TEXT
+        self.max_message_bytes = max_message_bytes
         self.closed = False
 
     # -- byte feed from the HTTP protocol -----------------------------------
@@ -52,12 +64,33 @@ class Connection:
             return
         self._buffer.extend(data)
         while True:
-            frame = decode_frame(bytes(self._buffer))
+            try:
+                frame = decode_frame(bytes(self._buffer),
+                                     max_length=self.max_message_bytes,
+                                     require_mask=True)
+            except ProtocolError as exc:
+                self._fail(exc)
+                return
             if frame is None:
                 return
             opcode, fin, payload, consumed = frame
             del self._buffer[:consumed]
             self._on_frame(opcode, fin, payload)
+
+    def _fail(self, exc: ProtocolError) -> None:
+        """Fail the connection per RFC 6455 §7.1.7: send a close frame with
+        the violation's status code (1002 protocol error / 1009 too big),
+        stop reading, and drop the transport."""
+        if not self.closed:
+            self._send_raw(encode_close(exc.close_code,
+                                        str(exc).encode()[:120]))
+            self.closed = True
+        self._buffer.clear()
+        self._fragments = []
+        self._fragment_len = 0
+        self._messages.put_nowait(None)
+        if self.transport is not None and not self.transport.is_closing():
+            self.transport.close()
 
     def _on_frame(self, opcode: int, fin: bool, payload: bytes) -> None:
         if opcode == OP_PING:
@@ -76,13 +109,20 @@ class Connection:
                 self._deliver(opcode, payload)
             else:
                 self._fragments = [payload]
+                self._fragment_len = len(payload)
                 self._fragment_op = opcode
             return
         if opcode == OP_CONT:
+            self._fragment_len += len(payload)
+            if self._fragment_len > self.max_message_bytes:
+                self._fail(FrameTooLarge(
+                    f"fragmented message exceeds {self.max_message_bytes}"))
+                return
             self._fragments.append(payload)
             if fin:
                 data = b"".join(self._fragments)
                 self._fragments = []
+                self._fragment_len = 0
                 self._deliver(self._fragment_op, data)
 
     def _deliver(self, opcode: int, payload: bytes) -> None:

@@ -21,6 +21,26 @@ OP_PONG = 0xA
 
 MAGIC_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
+CLOSE_PROTOCOL_ERROR = 1002
+CLOSE_MESSAGE_TOO_BIG = 1009
+
+
+class ProtocolError(ValueError):
+    """RFC 6455 violation — the connection must be failed (close 1002)."""
+
+    close_code = CLOSE_PROTOCOL_ERROR
+
+
+class FrameTooLarge(ProtocolError):
+    """Declared frame length exceeds the server limit (close 1009)."""
+
+    close_code = CLOSE_MESSAGE_TOO_BIG
+
+
+def encode_close(code: int, reason: bytes = b"") -> bytes:
+    """A CLOSE frame carrying a 2-byte status code + optional reason."""
+    return encode_frame(OP_CLOSE, struct.pack(">H", code) + reason)
+
 
 def accept_key(sec_websocket_key: str) -> str:
     import base64
@@ -51,9 +71,17 @@ def encode_frame(opcode: int, payload: bytes, fin: bool = True,
     return bytes(head) + payload
 
 
-def decode_frame(buffer: bytes) -> Optional[Tuple[int, bool, bytes, int]]:
+def decode_frame(buffer: bytes, max_length: Optional[int] = None,
+                 require_mask: bool = False,
+                 ) -> Optional[Tuple[int, bool, bytes, int]]:
     """Parse one frame from ``buffer``. Returns (opcode, fin, payload,
-    consumed) or None if incomplete."""
+    consumed) or None if incomplete.
+
+    ``max_length`` rejects over-limit frames *from the declared length*
+    (before buffering the payload) with :class:`FrameTooLarge`;
+    ``require_mask`` fails unmasked frames with :class:`ProtocolError`
+    (RFC 6455 §5.1: client→server frames MUST be masked).
+    """
     if len(buffer) < 2:
         return None
     b0, b1 = buffer[0], buffer[1]
@@ -72,6 +100,10 @@ def decode_frame(buffer: bytes) -> Optional[Tuple[int, bool, bytes, int]]:
             return None
         length = struct.unpack_from(">Q", buffer, offset)[0]
         offset += 8
+    if require_mask and not masked:
+        raise ProtocolError("unmasked client frame")
+    if max_length is not None and length > max_length:
+        raise FrameTooLarge(f"frame of {length} bytes exceeds {max_length}")
     key = b""
     if masked:
         if len(buffer) < offset + 4:

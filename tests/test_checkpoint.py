@@ -89,3 +89,21 @@ def test_train_resume_equivalence(tmp_path):
     for a, b in zip(jax.tree.leaves(restored_params),
                     jax.tree.leaves(state.params)):
         assert a.shape == b.shape
+
+
+def test_overwrite_same_step_never_loses_checkpoint(tmp_path):
+    """Re-saving step N publishes atomically: the old copy is moved aside
+    before the new one is renamed in (ADVICE r1), so a reader never sees a
+    missing step directory."""
+    import os
+    from gofr_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    save_checkpoint(str(tmp_path), tree, step=3)
+    tree2 = {"w": np.arange(4, dtype=np.float32) * 7}
+    path = save_checkpoint(str(tmp_path), tree2, step=3)
+    assert os.path.isdir(path)
+    out = restore_checkpoint(str(tmp_path), tree, step=3)
+    np.testing.assert_allclose(out["w"], tree2["w"])
+    # no stray tmp/old dirs left behind
+    stray = [n for n in os.listdir(tmp_path) if not n.startswith("step_")]
+    assert stray == []

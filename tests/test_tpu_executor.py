@@ -170,3 +170,21 @@ def test_container_wires_tpu_executor():
     assert container.tpu is not None
     health = container.health()
     assert "tpu" in health
+
+
+def test_bucket_ladder_rounds_up_to_dp_multiple(mock_container):
+    """Uneven buckets over a dp mesh would make device_put raise (ADVICE r1):
+    the ladder must be rounded to multiples of the dp axis at register()."""
+    from gofr_tpu.parallel import make_mesh
+    mesh = make_mesh({"dp": 8})
+    executor = Executor(mock_container.logger, mock_container.metrics,
+                        mesh=mesh)
+    fn, params = _simple_model()
+    executor.register("double", fn, params, buckets=(1, 2, 4, 8, 16, 32))
+    assert executor._models["double"].buckets == (8, 16, 32)
+    # small batches now pad to a dp-divisible bucket and still serve
+    out = executor.predict("double", np.ones((3, 4), np.float32))
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out, np.ones((3, 4)) * 2 + np.arange(4))
+    executor.warmup("double", np.ones((4,), np.float32))
+    assert sorted(executor._models["double"].compiled) == [8, 16, 32]

@@ -267,3 +267,108 @@ def test_openapi_routes(tmp_path, monkeypatch):
             assert ui.status == 200
             assert b"API documentation" in ui.body
     run(main())
+
+
+def test_websocket_fragmented_message_reassembly():
+    from gofr_tpu.websocket.frames import OP_CONT, OP_TEXT, encode_frame
+
+    async def main():
+        app = make_app()
+
+        async def once(ctx):
+            message = await ctx.read_message()
+            await ctx.write_message(f"got: {message}")
+
+        app.websocket("/ws", once)
+        async with serving(app) as port:
+            reader, writer = await _ws_client(port)
+            writer.write(encode_frame(OP_TEXT, b"ab", fin=False, mask=True))
+            writer.write(encode_frame(OP_CONT, b"cd", fin=False, mask=True))
+            writer.write(encode_frame(OP_CONT, b"ef", fin=True, mask=True))
+            await writer.drain()
+            opcode, payload = await _ws_recv(reader)
+            assert payload == b"got: abcdef"
+            writer.close()
+    run(main())
+
+
+def test_websocket_oversized_frame_closes_1009():
+    import struct
+
+    async def main():
+        app = make_app()
+
+        async def handler(ctx):
+            while True:
+                await ctx.read_message()
+
+        app.websocket("/ws", handler)
+        async with serving(app) as port:
+            reader, writer = await _ws_client(port)
+            # declared 2**40-byte masked frame: header only, no payload
+            head = bytes([0x81, 0x80 | 127]) + struct.pack(">Q", 1 << 40) \
+                + b"\x00\x00\x00\x00"
+            writer.write(head)
+            await writer.drain()
+            from gofr_tpu.websocket.frames import OP_CLOSE
+            opcode, payload = await _ws_recv(reader)
+            assert opcode == OP_CLOSE
+            assert struct.unpack(">H", payload[:2])[0] == 1009
+            writer.close()
+    run(main())
+
+
+def test_websocket_unmasked_client_frame_closes_1002():
+    import struct
+    from gofr_tpu.websocket.frames import OP_CLOSE, OP_TEXT, encode_frame
+
+    async def main():
+        app = make_app()
+
+        async def handler(ctx):
+            while True:
+                await ctx.read_message()
+
+        app.websocket("/ws", handler)
+        async with serving(app) as port:
+            reader, writer = await _ws_client(port)
+            writer.write(encode_frame(OP_TEXT, b"hi", mask=False))
+            await writer.drain()
+            opcode, payload = await _ws_recv(reader)
+            assert opcode == OP_CLOSE
+            assert struct.unpack(">H", payload[:2])[0] == 1002
+            writer.close()
+    run(main())
+
+
+def test_websocket_fragment_flood_closes_1009():
+    import struct
+    from gofr_tpu.websocket.connection import Connection
+    from gofr_tpu.websocket.frames import (
+        OP_CLOSE, OP_CONT, OP_TEXT, decode_frame, encode_frame)
+
+    class FakeTransport:
+        def __init__(self):
+            self.sent = b""
+            self.closed = False
+
+        def write(self, data):
+            self.sent += data
+
+        def is_closing(self):
+            return self.closed
+
+        def close(self):
+            self.closed = True
+
+    async def main():
+        transport = FakeTransport()
+        conn = Connection(transport, "k", "/ws", max_message_bytes=1024)
+        conn.feed(encode_frame(OP_TEXT, b"x" * 512, fin=False, mask=True))
+        assert not transport.closed
+        conn.feed(encode_frame(OP_CONT, b"y" * 600, fin=False, mask=True))
+        assert transport.closed
+        frame = decode_frame(transport.sent)
+        assert frame[0] == OP_CLOSE
+        assert struct.unpack(">H", frame[2][:2])[0] == 1009
+    run(main())
