@@ -6,9 +6,10 @@ Serving engine: slot-based continuous batching (gofr_tpu.tpu.GenerationEngine)
 cache regions without recompiles. Uses the framework BPE tokenizer (C++
 encode path when the toolchain is present).
 
-For tensor parallelism over a slice set ``TPU_MESH=dp:1,tp:8`` and shard
-params with gofr_tpu.parallel.llama_param_specs before building the engine
-(Megatron column/row specs; XLA inserts the all-reduces over ICI).
+For tensor parallelism over a slice set ``TPU_MESH=dp:1,tp:8``: the engine
+shards params with gofr_tpu.parallel.llama_param_specs (Megatron column/row
+specs) and the KV cache with llama_cache_specs (slots on dp, kv-heads on
+tp); XLA inserts the all-reduces over ICI.
 
 POST /generate {"prompt": "...", "max_new_tokens": 32}
 """
@@ -32,24 +33,22 @@ def build_app():
     cfg = llama.config(preset, vocab_size=256)  # byte-level vocab
     params = llama.init(cfg, jax.random.PRNGKey(0))
 
+    mesh = None
     if app.config.get("TPU_MESH"):
-        from gofr_tpu.parallel import (
-            llama_param_specs, make_mesh, prune_specs, shard_pytree)
+        from gofr_tpu.parallel import make_mesh
         axes = {}
         for part in str(app.config.get("TPU_MESH")).split(","):
             axis, _, size = part.partition(":")
             axes[axis.strip()] = int(size)
         mesh = make_mesh(axes)
-        params = shard_pytree(params, mesh,
-                              prune_specs(llama_param_specs(), mesh))
 
     tokenizer = Tokenizer()  # byte-level; swap in a trained vocab via load()
     engine = GenerationEngine(
-        cfg, params,
+        cfg, params, mesh=mesh,
         max_slots=int(os.environ.get("GENERATE_SLOTS", "8")),
         max_len=min(cfg.max_seq_len, 1024),
-        # fused decode steps per host round trip (5x aggregate tok/s on the
-        # relay-attached chip; trade-off: ≤K-1 discarded tokens past eos)
+        # fused decode steps per host round trip (amortises dispatch; the
+        # adaptive ladder drops back to 1 while admissions are waiting)
         steps_per_tick=int(os.environ.get("STEPS_PER_TICK", "4")),
         logger=app.logger, metrics=app.container.metrics)
     app.container.tpu = engine  # surfaces engine health under /.well-known

@@ -9,6 +9,7 @@ from gofr_tpu.ops.attention import (
     attention,
     causal_mask,
     decode_attention,
+    decode_attention_cached,
     prefill_attention,
 )
 from gofr_tpu.ops.norms import layer_norm, rms_norm

@@ -1,28 +1,41 @@
 """Continuous-batching generation engine for the Llama /generate path.
 
-North star config 5 (BASELINE.json): "Llama-2-7B /generate ... KV-cache in
-HBM ... continuous batching on the generate loop" (SURVEY.md §7.7). The
-design is slot-based continuous batching:
+North star config 5 (BASELINE.json): "Llama-2-7B /generate, tensor-parallel
+across v5e-8, KV-cache in HBM ... continuous batching on the generate loop"
+(SURVEY.md §7.7). The design is slot-based continuous batching:
 
 - One static-shape KV cache of ``max_slots`` sequences lives in HBM for
-  the engine's lifetime (no per-request allocation).
-- A new request claims a free slot: its prompt is right-padded to a
-  compiled length bucket and prefilled *into that slot* of the big cache
-  (one compiled prefill executable per bucket).
-- A single decode executable advances ALL active slots one token per tick
-  — requests join and leave mid-flight without recompiles or barriers,
-  so decode MXU work is amortised across every concurrent request.
-- Per-slot host state (remaining budget, eos, emitted tokens) stays in
-  numpy; device state is just (cache, cache_len, last_token).
+  the engine's lifetime (no per-request allocation). With a ``mesh`` it is
+  sharded: slots over ``dp``, kv-heads over ``tp``
+  (parallel/sharding.llama_cache_specs); params get the Megatron
+  column/row specs (llama_param_specs) so XLA inserts one all-reduce per
+  block over ICI.
+- A new request claims a free slot. Admissions are *batched*: all
+  requests pending at the top of a loop iteration prefill together in one
+  executable (count padded to a ladder, prompts right-padded to a length
+  bucket). Prefill is split into two executables — a pure-compute forward
+  producing the prompt KV, and a cheap scatter that inserts it into the
+  big cache — so the expensive half needs no exclusive cache ownership.
+- A single decode executable advances ALL active slots ``K`` tokens per
+  tick (``lax.scan`` inside one program, K chosen adaptively from a
+  compiled ladder up to ``steps_per_tick``). Requests join and leave
+  mid-flight without recompiles or barriers.
+- The loop is *pipelined*: tick N+1 is dispatched (JAX async dispatch)
+  before tick N's tokens are fetched to host, so host-side bookkeeping
+  and the device never wait on each other.
+- Inactive slots are frozen in the decode executable (cache_len does not
+  advance), so an idle slot's window never grows between requests.
+- Per-slot host state (remaining budget, eos, emitted tokens, generation
+  counter) stays in numpy; device state is (cache, cache_len, last_token).
 
-Everything here is single-executable static-shape XLA: the engine never
-traces after warmup.
+Everything here is static-shape XLA: the engine never traces after the
+executable ladders are warm.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -30,7 +43,8 @@ DEFAULT_PROMPT_BUCKETS = (32, 128, 512)
 
 
 class _Slot:
-    __slots__ = ("future", "remaining", "eos_id", "tokens", "active")
+    __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
+                 "inflight")
 
     def __init__(self):
         self.future: Optional[asyncio.Future] = None
@@ -38,6 +52,8 @@ class _Slot:
         self.eos_id: Optional[int] = None
         self.tokens: List[int] = []
         self.active = False
+        self.gen = 0          # bumped on claim: stale tick tokens are dropped
+        self.inflight = 0     # tokens dispatched on device, not yet published
 
 
 class GenerationEngine:
@@ -45,6 +61,7 @@ class GenerationEngine:
                  max_len: Optional[int] = None,
                  prompt_buckets=DEFAULT_PROMPT_BUCKETS,
                  steps_per_tick: int = 1,
+                 mesh=None,
                  logger=None, metrics=None):
         import jax
         import jax.numpy as jnp
@@ -55,20 +72,41 @@ class GenerationEngine:
         self._jnp = jnp
         self._llama = llama
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None and "dp" in mesh.shape:
+            dp = mesh.shape["dp"]
+            max_slots = -(-max_slots // dp) * dp   # round up: dp-divisible
         self.max_slots = max_slots
         self.max_len = max_len or cfg.max_seq_len
         self.prompt_buckets = tuple(
             b for b in sorted(prompt_buckets) if b <= self.max_len)
-        # multi-step scheduling: K fused decode steps per host round trip
-        # (lax.scan inside one executable). Amortises dispatch/sync latency
-        # K-fold at the cost of ≤K-1 discarded tokens past an eos.
+        # ladder of fused-steps-per-tick executables (1,2,4,...,K): the loop
+        # picks the largest rung ≤ the smallest remaining budget so budget
+        # is never overshot, and drops to 1 while admissions are waiting.
         self.steps_per_tick = max(1, int(steps_per_tick))
+        self._k_ladder = [1]
+        while self._k_ladder[-1] * 2 <= self.steps_per_tick:
+            self._k_ladder.append(self._k_ladder[-1] * 2)
+        # admission-count ladder: 1,2,4,... up to max_slots
+        self._n_ladder = [1]
+        while self._n_ladder[-1] * 2 <= max_slots:
+            self._n_ladder.append(self._n_ladder[-1] * 2)
         self.logger = logger
         self.metrics = metrics
 
-        self.params = jax.device_put(params)
-        self.cache = jax.device_put(
-            llama.init_cache(cfg, max_slots, self.max_len))
+        if mesh is not None:
+            from gofr_tpu.parallel.sharding import (
+                llama_cache_specs, llama_param_specs, prune_specs,
+                shard_pytree)
+            self.params = shard_pytree(
+                params, mesh, prune_specs(llama_param_specs(), mesh))
+            cache = llama.init_cache(cfg, max_slots, self.max_len)
+            self.cache = shard_pytree(
+                cache, mesh, prune_specs(llama_cache_specs(), mesh))
+        else:
+            self.params = jax.device_put(params)
+            self.cache = jax.device_put(
+                llama.init_cache(cfg, max_slots, self.max_len))
         self.cache_len = jnp.zeros((max_slots,), jnp.int32)
         self.last_token = jnp.zeros((max_slots,), jnp.int32)
 
@@ -78,52 +116,109 @@ class GenerationEngine:
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._steps = 0
+        self._prefills = 0
 
-        self._prefill_fns: Dict[int, Any] = {}
-        self._decode_fn = None
+        self._prefill_fns: Dict[Tuple[int, int], Any] = {}
+        self._insert_fns: Dict[Tuple[int, int], Any] = {}
+        self._decode_fns: Dict[int, Any] = {}
 
     # -- compiled steps -----------------------------------------------------
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
+    def _prefill_fn(self, nb: int, lb: int):
+        """Pure-compute prompt forward for ``nb`` prompts of bucket ``lb``:
+        (params, tokens (nb,lb), lengths (nb,)) → (first_tokens (nb,),
+        k_small, v_small (L,nb,lb,Hkv,Dh)). No cache involvement, so it can
+        be dispatched while decode ticks are in flight."""
+        fn = self._prefill_fns.get((nb, lb))
         if fn is None:
             jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
                                     self.cfg)
 
-            def prefill_slot(params, tokens, length, cache, slot):
-                """tokens (1, bucket) right-padded; scatter the slot's KV."""
-                small = llama.init_cache(cfg, 1, self.max_len)
-                logits, small, _ = llama.prefill(
-                    params, cfg, tokens, small, lengths=length)
-                new_cache = {
-                    "k": cache["k"].at[:, slot].set(small["k"][:, 0]),
-                    "v": cache["v"].at[:, slot].set(small["v"][:, 0]),
-                }
-                return logits[0], new_cache
+            def prefill_batch(params, tokens, lengths):
+                small = llama.init_cache(cfg, nb, lb)
+                logits, small, _ = llama.prefill(params, cfg, tokens, small,
+                                                 lengths=lengths)
+                first = logits.argmax(axis=-1).astype(jnp.int32)
+                return first, small["k"], small["v"]
 
-            fn = jax.jit(prefill_slot, donate_argnums=(3,))
-            self._prefill_fns[bucket] = fn
+            fn = jax.jit(prefill_batch)
+            self._prefill_fns[(nb, lb)] = fn
         return fn
 
-    def _decode(self):
-        if self._decode_fn is None:
-            jax, llama, cfg = self._jax, self._llama, self.cfg
-            from jax import lax
-            steps = self.steps_per_tick
+    def _insert_fn(self, nb: int, lb: int):
+        """Cheap scatter publishing a prefill into the big cache. Padding
+        entries carry slot index ``max_slots`` (out of bounds → dropped)."""
+        fn = self._insert_fns.get((nb, lb))
+        if fn is None:
+            jax = self._jax
 
-            def decode_all(params, token, cache, cache_len):
+            def insert(cache, k_small, v_small, slots, lengths, first,
+                       cache_len, last_token):
+                k = cache["k"].at[:, slots, :lb].set(k_small, mode="drop")
+                v = cache["v"].at[:, slots, :lb].set(v_small, mode="drop")
+                cache_len = cache_len.at[slots].set(lengths, mode="drop")
+                last_token = last_token.at[slots].set(first, mode="drop")
+                return {"k": k, "v": v}, cache_len, last_token
+
+            fn = jax.jit(insert, donate_argnums=(0, 6, 7))
+            self._insert_fns[(nb, lb)] = fn
+        return fn
+
+    def _decode_fn(self, k_steps: int):
+        fn = self._decode_fns.get(k_steps)
+        if fn is None:
+            jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
+                                    self.cfg)
+            from jax import lax
+
+            def decode_k(params, token, cache, cache_len, active):
                 def one(carry, _):
                     token, cache, cache_len = carry
-                    logits, cache, cache_len = llama.decode_step(
+                    logits, cache, new_len = llama.decode_step(
                         params, cfg, token, cache, cache_len)
                     next_token = logits.argmax(axis=-1).astype(token.dtype)
-                    return (next_token, cache, cache_len), next_token
+                    # freeze inactive slots: cache_len stays put and the
+                    # carried token is unchanged (ADVICE r1: no unbounded
+                    # cache_len growth on idle slots)
+                    new_len = jnp.where(active, new_len, cache_len)
+                    next_token = jnp.where(active, next_token, token)
+                    return (next_token, cache, new_len), next_token
 
                 (token, cache, cache_len), tokens = lax.scan(
-                    one, (token, cache, cache_len), None, length=steps)
+                    one, (token, cache, cache_len), None, length=k_steps)
                 return tokens, cache, cache_len   # tokens: (K, B)
 
-            self._decode_fn = jax.jit(decode_all, donate_argnums=(2,))
-        return self._decode_fn
+            fn = jax.jit(decode_k, donate_argnums=(2, 3))
+            self._decode_fns[k_steps] = fn
+        return fn
+
+    async def warmup(self, prompt_counts: Tuple[int, ...] = (1,)) -> None:
+        """Pre-compile the decode ladder and prefill/insert executables so
+        the serving path never traces (executor.warmup analog)."""
+        jnp = self._jnp
+        loop = asyncio.get_running_loop()
+
+        def compile_all():
+            active = jnp.zeros((self.max_slots,), bool)
+            for k in self._k_ladder:
+                tokens, cache, cache_len = self._decode_fn(k)(
+                    self.params, self.last_token, self.cache, self.cache_len,
+                    active)
+                self.cache, self.cache_len = cache, cache_len
+            for lb in self.prompt_buckets:
+                for n in prompt_counts:
+                    nb = next(x for x in self._n_ladder if x >= n)
+                    toks = jnp.zeros((nb, lb), jnp.int32)
+                    lens = jnp.ones((nb,), jnp.int32)
+                    first, k_small, v_small = self._prefill_fn(nb, lb)(
+                        self.params, toks, lens)
+                    slots = jnp.full((nb,), self.max_slots, jnp.int32)
+                    self.cache, self.cache_len, self.last_token = \
+                        self._insert_fn(nb, lb)(
+                            self.cache, k_small, v_small, slots, lens, first,
+                            self.cache_len, self.last_token)
+            self._jax.block_until_ready(self.cache)
+
+        await loop.run_in_executor(None, compile_all)
 
     # -- public API ---------------------------------------------------------
     async def start(self) -> None:
@@ -166,7 +261,9 @@ class GenerationEngine:
         return {"active_slots": self.active_slots,
                 "free_slots": len(self._free),
                 "decode_steps": self._steps,
-                "max_len": self.max_len}
+                "prefill_batches": self._prefills,
+                "max_len": self.max_len,
+                "mesh": dict(self.mesh.shape) if self.mesh else None}
 
     def health_check(self) -> Dict[str, Any]:
         """Container-health contract (container/health.go analog)."""
@@ -184,13 +281,70 @@ class GenerationEngine:
 
     # -- engine loop --------------------------------------------------------
     async def _loop(self) -> None:
-        jnp = self._jnp
-        np_token = np.zeros((self.max_slots,), np.int32)
+        loop = asyncio.get_running_loop()
+        prev_tick = None      # (tokens_dev (K,B), [(slot_idx, gen)])
+        first_fetches: List[Tuple[Any, List[Tuple[int, int, int]]]] = []
         while True:
-            # admit as many pending requests as there are free slots
-            while self._free and not self._pending.empty():
-                prompt, bucket, budget, eos_id, future = \
-                    self._pending.get_nowait()
+            # 1. batched admission of everything pending (up to free slots)
+            first_fetches.extend(await self._admit_pending(loop))
+
+            if (self.active_slots == 0 and prev_tick is None
+                    and not first_fetches):
+                if self._pending.empty():
+                    self._wake.clear()
+                    await self._wake.wait()
+                continue
+
+            # 2. dispatch the next decode tick before touching host results
+            #    (pipelining: the device runs while we do bookkeeping and
+            #    fetch the *previous* tick's tokens)
+            cur_tick = None
+            if self.active_slots > 0:
+                cur_tick = await self._dispatch_tick(loop)
+
+            # 3. publish prefill first-tokens in admission order
+            for first_dev, claimed in first_fetches:
+                first_host = await loop.run_in_executor(
+                    None, np.asarray, first_dev)
+                for slot_idx, gen, row in claimed:
+                    self._push_tokens(slot_idx, gen, [int(first_host[row])])
+            first_fetches = []
+
+            # 4. fetch + publish the previous tick's tokens
+            if prev_tick is not None:
+                tokens_dev, snapshot = prev_tick
+                tokens_host = await loop.run_in_executor(
+                    None, np.asarray, tokens_dev)
+                for slot_idx, gen in snapshot:
+                    self._push_tokens(slot_idx, gen,
+                                      [int(t) for t in
+                                       tokens_host[:, slot_idx]])
+            prev_tick = cur_tick
+
+    async def _admit_pending(self, loop):
+        """Drain the queue into slots; one batched prefill dispatch per
+        prompt-length bucket. Returns [(first_dev, [(slot, gen, row)])]
+        fetch handles for the first generated tokens."""
+        requests: List[Tuple[List[int], int, int, Optional[int],
+                             asyncio.Future]] = []
+        while self._free[len(requests):] and not self._pending.empty():
+            requests.append(self._pending.get_nowait())
+        if not requests:
+            return []
+        jnp = self._jnp
+        fetches: List[Tuple[Any, List[Tuple[int, int, int]]]] = []
+        by_bucket: Dict[int, List[Tuple[List[int], int, Optional[int],
+                                        asyncio.Future]]] = {}
+        for prompt, bucket, budget, eos_id, future in requests:
+            by_bucket.setdefault(bucket, []).append(
+                (prompt, budget, eos_id, future))
+        for bucket, group in sorted(by_bucket.items()):
+            nb = next(x for x in self._n_ladder if x >= len(group))
+            padded = np.zeros((nb, bucket), np.int32)
+            lengths = np.ones((nb,), np.int32)
+            slots = np.full((nb,), self.max_slots, np.int32)  # OOB → drop
+            claimed: List[Tuple[int, int, int]] = []          # (slot,gen,row)
+            for row, (prompt, budget, eos_id, future) in enumerate(group):
                 slot_idx = self._free.pop()
                 slot = self._slots[slot_idx]
                 slot.future = future
@@ -198,67 +352,97 @@ class GenerationEngine:
                 slot.eos_id = eos_id
                 slot.tokens = []
                 slot.active = True
-                await asyncio.get_running_loop().run_in_executor(
-                    None, self._admit, slot_idx, prompt, bucket)
-                # prefill produced the first generated token
-                first = slot.tokens[0]
-                slot.remaining -= 1
-                if slot.remaining <= 0 or (slot.eos_id is not None
-                                           and first == slot.eos_id):
-                    slot.active = False
-                    self._free.append(slot_idx)
-                    if not future.done():
-                        future.set_result(list(slot.tokens))
+                slot.gen += 1
+                slot.inflight = 1          # the prefill's first token
+                padded[row, :len(prompt)] = prompt
+                lengths[row] = len(prompt)
+                slots[row] = slot_idx
+                claimed.append((slot_idx, slot.gen, row))
 
-            if self.active_slots == 0:
-                self._wake.clear()
-                await self._wake.wait()
-                continue
+            def dispatch(bucket=bucket, nb=nb, padded=padded,
+                         lengths=lengths, slots=slots):
+                first, k_small, v_small = self._prefill_fn(nb, bucket)(
+                    self.params, jnp.asarray(padded), jnp.asarray(lengths))
+                self.cache, self.cache_len, self.last_token = \
+                    self._insert_fn(nb, bucket)(
+                        self.cache, k_small, v_small, jnp.asarray(slots),
+                        jnp.asarray(lengths), first,
+                        self.cache_len, self.last_token)
+                return first
 
-            # one decode tick: K fused steps for every active slot
-            tick_tokens, self.cache, self.cache_len = await \
-                asyncio.get_running_loop().run_in_executor(
-                    None, self._decode_tick)
-            self._steps += 1
-            if self.metrics is not None:
-                self.metrics.record_histogram(
-                    "app_tpu_batch_size", float(self.active_slots),
-                    model="generate")
-            for slot_idx, slot in enumerate(self._slots):
-                if not slot.active:
-                    continue
-                for step in range(tick_tokens.shape[0]):
-                    token = int(tick_tokens[step, slot_idx])
-                    slot.tokens.append(token)
-                    slot.remaining -= 1
-                    if (slot.remaining <= 0
-                            or (slot.eos_id is not None
-                                and token == slot.eos_id)):
-                        slot.active = False   # rest of chunk discarded
-                        self._free.append(slot_idx)
-                        if slot.future is not None \
-                                and not slot.future.done():
-                            slot.future.set_result(list(slot.tokens))
-                        break
-            self.last_token = jnp.asarray(tick_tokens[-1])
+            # first-time compiles run off-loop; warm dispatch is ~free
+            if (nb, bucket) in self._prefill_fns \
+                    and (nb, bucket) in self._insert_fns:
+                first_dev = dispatch()
+            else:
+                first_dev = await loop.run_in_executor(None, dispatch)
+            self._prefills += 1
+            fetches.append((first_dev, claimed))
+        return fetches
 
-    def _admit(self, slot_idx: int, prompt: List[int], bucket: int) -> None:
-        """Blocking prefill of one slot (runs in the executor thread)."""
+    async def _dispatch_tick(self, loop):
+        """Choose K adaptively, dispatch one decode executable, return
+        (device tokens handle, active snapshot) without syncing. Skips the
+        tick (returns None) when every active slot's budget is already
+        covered by in-flight tokens — no speculative overshoot."""
         jnp = self._jnp
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :len(prompt)] = prompt
-        length = jnp.asarray([len(prompt)], jnp.int32)
-        logits, self.cache = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(padded), length, self.cache,
-            slot_idx)
-        first = int(np.asarray(logits).argmax())
-        self.last_token = self.last_token.at[slot_idx].set(first)
-        self.cache_len = self.cache_len.at[slot_idx].set(len(prompt))
-        slot = self._slots[slot_idx]
-        slot.tokens = [first]
+        min_wanted = min(slot.remaining - slot.inflight
+                         for slot in self._slots if slot.active)
+        if min_wanted <= 0:
+            return None
+        k = 1
+        if self._pending.empty():
+            for rung in self._k_ladder:
+                if rung <= min_wanted:
+                    k = rung
+        active = np.zeros((self.max_slots,), bool)
+        snapshot = []
+        for slot_idx, slot in enumerate(self._slots):
+            if slot.active:
+                active[slot_idx] = True
+                slot.inflight += k
+                snapshot.append((slot_idx, slot.gen))
+        # keep the mask device-resident: re-upload only when the active set
+        # changed (H2D through a relay costs ~10ms; most ticks are stable)
+        key = active.tobytes()
+        if getattr(self, "_mask_key", None) != key:
+            self._mask_dev = jnp.asarray(active)
+            self._mask_key = key
 
-    def _decode_tick(self):
-        next_token, cache, cache_len = self._decode()(
-            self.params, self.last_token, self.cache, self.cache_len)
-        self._jax.block_until_ready(next_token)
-        return np.asarray(next_token), cache, cache_len
+        def dispatch():
+            tokens_dev, self.cache, self.cache_len = self._decode_fn(k)(
+                self.params, self.last_token, self.cache, self.cache_len,
+                self._mask_dev)
+            self.last_token = tokens_dev[-1]
+            return tokens_dev
+
+        if k in self._decode_fns:
+            tokens_dev = dispatch()
+        else:
+            tokens_dev = await loop.run_in_executor(None, dispatch)
+        self._steps += 1
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_tpu_batch_size", float(len(snapshot)), model="generate")
+        return tokens_dev, snapshot
+
+    def _push_tokens(self, slot_idx: int, gen: int,
+                     tokens: List[int]) -> None:
+        """Append generated tokens to a slot, handling eos/budget; stale
+        generations (slot reclaimed since dispatch) are dropped."""
+        slot = self._slots[slot_idx]
+        if slot.gen != gen:
+            return
+        slot.inflight -= len(tokens)
+        if not slot.active:
+            return
+        for token in tokens:
+            slot.tokens.append(token)
+            slot.remaining -= 1
+            if (slot.remaining <= 0
+                    or (slot.eos_id is not None and token == slot.eos_id)):
+                slot.active = False    # rest of the chunk is discarded
+                self._free.append(slot_idx)
+                if slot.future is not None and not slot.future.done():
+                    slot.future.set_result(list(slot.tokens))
+                break

@@ -142,3 +142,81 @@ def test_multi_step_scheduling_matches_reference(setup):
         finally:
             await engine.stop()
     asyncio.run(main())
+
+
+def test_mesh_engine_matches_single_device(setup):
+    """BASELINE.md config 5 shape: tensor-parallel engine over a dp×tp mesh
+    must produce token-identical output to the single-device engine —
+    params sharded with llama_param_specs, KV cache with llama_cache_specs
+    (slots on dp, kv-heads on tp)."""
+    cfg, params = setup
+    from gofr_tpu.parallel import make_mesh
+    mesh = make_mesh({"dp": 4, "tp": 2})
+
+    async def main():
+        single = _make_engine(cfg, params)
+        sharded = _make_engine(cfg, params, mesh=mesh)
+        assert sharded.max_slots % 4 == 0
+        # cache actually carries the mesh sharding
+        spec = sharded.cache["k"].sharding.spec
+        assert tuple(spec) == (None, "dp", None, "tp", None)
+        await single.start()
+        await sharded.start()
+        try:
+            prompts = [[1, 2, 3], [9, 8, 7, 6], [4, 4], [5]]
+            ref = await asyncio.wait_for(asyncio.gather(*[
+                single.generate(p, max_new_tokens=6) for p in prompts]),
+                120.0)
+            out = await asyncio.wait_for(asyncio.gather(*[
+                sharded.generate(p, max_new_tokens=6) for p in prompts]),
+                120.0)
+            assert out == ref
+        finally:
+            await single.stop()
+            await sharded.stop()
+    asyncio.run(main())
+
+
+def test_engine_warmup_precompiles(setup):
+    cfg, params = setup
+
+    async def main():
+        engine = _make_engine(cfg, params, steps_per_tick=4)
+        await engine.start()
+        await engine.warmup(prompt_counts=(1, 2))
+        assert sorted(engine._decode_fns) == [1, 2, 4]
+        assert set(engine._prefill_fns) == {(1, 8), (1, 16), (2, 8), (2, 16)}
+        try:
+            out = await asyncio.wait_for(
+                engine.generate([1, 2, 3], max_new_tokens=5), 60.0)
+            assert len(out) == 5
+        finally:
+            await engine.stop()
+    asyncio.run(main())
+
+
+def test_inactive_slots_frozen(setup):
+    """ADVICE r1: a freed slot's cache_len must not grow while other slots
+    keep decoding. Run a short and a long request concurrently: the short
+    one's slot must sit at exactly prompt+budget when the long one ends."""
+    cfg, params = setup
+    import numpy as np
+
+    async def main():
+        engine = _make_engine(cfg, params)
+        await engine.start()
+        try:
+            long_req = asyncio.ensure_future(
+                engine.generate([1, 2, 3, 4, 5], max_new_tokens=16))
+            short_req = asyncio.ensure_future(
+                engine.generate([7, 8], max_new_tokens=2))
+            await asyncio.wait_for(
+                asyncio.gather(long_req, short_req), 120.0)
+            lens = sorted(int(x) for x in np.asarray(engine.cache_len))
+            # cache holds prompt + budget-1 positions (the final emitted
+            # token is never scattered): long 5+15=20, short 2+1=3
+            # (frozen there while long kept decoding), rest 0
+            assert lens == [0, 0, 3, 20]
+        finally:
+            await engine.stop()
+    asyncio.run(main())
