@@ -63,23 +63,25 @@ def save_checkpoint(directory: str, tree: Any, step: int = 0,
                 "step": step,
                 "metadata": metadata or {},
             }, handle)
-        # Atomic publish even when overwriting: move the old copy aside
-        # first so a crash between the two renames leaves either the old
-        # or the new checkpoint in place, never neither.
+        # Atomic publish even when overwriting: move the old copy aside to
+        # the *discoverable* name ``step_N.old`` first, so a crash between
+        # the two renames leaves either the visible checkpoint or the .old
+        # fallback in place — latest_step/restore_checkpoint consult both.
         old = None
         if os.path.exists(final):
-            old = tempfile.mkdtemp(dir=directory, prefix=".old_ckpt_")
-            os.rmdir(old)
+            old = final + ".old"
+            if os.path.exists(old):
+                shutil.rmtree(old, ignore_errors=True)
             os.rename(final, old)
         try:
             os.rename(tmp, final)
         except Exception:
             if old is not None and not os.path.exists(final):
                 os.rename(old, final)  # roll the old checkpoint back in
-                old = None
             raise
-        if old is not None:
-            shutil.rmtree(old, ignore_errors=True)
+        # the fallback is stale once the new copy is visible (also clears
+        # a .old left by a previous crash when final itself was absent)
+        shutil.rmtree(final + ".old", ignore_errors=True)
     except Exception:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -87,11 +89,26 @@ def save_checkpoint(directory: str, tree: Any, step: int = 0,
 
 
 def latest_step(directory: str) -> Optional[int]:
+    """Max step with a readable checkpoint — ``step_N`` or the ``step_N.old``
+    fallback left by a crash mid-publish in save_checkpoint."""
     if not os.path.isdir(directory):
         return None
-    steps = [int(name[5:]) for name in os.listdir(directory)
-             if name.startswith("step_") and name[5:].isdigit()]
+    steps = set()
+    for name in os.listdir(directory):
+        if name.startswith("step_"):
+            tail = name[5:-4] if name.endswith(".old") else name[5:]
+            if tail.isdigit():
+                steps.add(int(tail))
     return max(steps) if steps else None
+
+
+def _step_path(directory: str, step: int) -> str:
+    """Resolve ``step_N``, falling back to ``step_N.old`` (crash window
+    between save_checkpoint's two renames)."""
+    path = os.path.join(directory, f"step_{step}")
+    if not os.path.isdir(path) and os.path.isdir(path + ".old"):
+        return path + ".old"
+    return path
 
 
 def restore_checkpoint(directory: str, like: Any, step: Optional[int] = None,
@@ -104,7 +121,7 @@ def restore_checkpoint(directory: str, like: Any, step: Optional[int] = None,
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
-    path = os.path.join(directory, f"step_{step}")
+    path = _step_path(directory, step)
     with open(os.path.join(path, "tree.json")) as handle:
         saved_dtypes = json.load(handle)["dtypes"]
     with np.load(os.path.join(path, "arrays.npz")) as archive:
@@ -141,5 +158,5 @@ def checkpoint_metadata(directory: str,
                         step: Optional[int] = None) -> Dict[str, Any]:
     if step is None:
         step = latest_step(directory)
-    with open(os.path.join(directory, f"step_{step}", "tree.json")) as f:
+    with open(os.path.join(_step_path(directory, step), "tree.json")) as f:
         return json.load(f)

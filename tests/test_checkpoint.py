@@ -105,5 +105,27 @@ def test_overwrite_same_step_never_loses_checkpoint(tmp_path):
     out = restore_checkpoint(str(tmp_path), tree, step=3)
     np.testing.assert_allclose(out["w"], tree2["w"])
     # no stray tmp/old dirs left behind
-    stray = [n for n in os.listdir(tmp_path) if not n.startswith("step_")]
-    assert stray == []
+    assert sorted(os.listdir(tmp_path)) == ["step_3"]
+
+
+def test_crash_window_old_checkpoint_is_discoverable(tmp_path):
+    """ADVICE r2: a crash between save_checkpoint's two renames leaves
+    ``step_N.old``; latest_step and restore_checkpoint must find it."""
+    import os
+    from gofr_tpu.utils.checkpoint import (checkpoint_metadata, latest_step,
+                                           restore_checkpoint,
+                                           save_checkpoint)
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    save_checkpoint(str(tmp_path), tree, step=5)
+    # simulate the crash window: visible dir moved aside, new rename lost
+    os.rename(tmp_path / "step_5", tmp_path / "step_5.old")
+    assert latest_step(str(tmp_path)) == 5
+    out = restore_checkpoint(str(tmp_path), tree, step=5)
+    np.testing.assert_allclose(out["w"], tree["w"])
+    assert checkpoint_metadata(str(tmp_path))["step"] == 5
+    # the next save of step 5 replaces the stale .old and publishes cleanly
+    tree2 = {"w": np.arange(4, dtype=np.float32) + 1}
+    save_checkpoint(str(tmp_path), tree2, step=5)
+    out = restore_checkpoint(str(tmp_path), tree, step=5)
+    np.testing.assert_allclose(out["w"], tree2["w"])
+    assert sorted(os.listdir(tmp_path)) == ["step_5"]
