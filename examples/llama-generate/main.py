@@ -50,8 +50,18 @@ def build_app():
         # fused decode steps per host round trip (amortises dispatch; the
         # adaptive ladder drops back to 1 while admissions are waiting)
         steps_per_tick=int(os.environ.get("STEPS_PER_TICK", "4")),
+        # decode ticks in flight before the oldest fetch must land: token
+        # fetches overlap device compute and each other (D2H pipelining)
+        max_inflight_ticks=int(os.environ.get("INFLIGHT_TICKS", "2")),
         logger=app.logger, metrics=app.container.metrics)
     app.container.tpu = engine  # surfaces engine health under /.well-known
+
+    @app.on_startup
+    async def warm_engine():
+        # precompile the decode ladder + prefill/insert executables before
+        # the first request: a cold compile is seconds of request latency
+        await engine.warmup(prompt_counts=(1, engine.max_slots))
+        await engine.start()
 
     async def generate(ctx):
         await engine.start()  # idempotent; binds to the serving loop

@@ -76,8 +76,16 @@ class App:
         self._metrics_server: Optional[HTTPServer] = None
         self._grpc_server = None
         self._tasks: List[asyncio.Task] = []
+        self._startup_hooks: List[Callable] = []
         self._shutdown: Optional[asyncio.Event] = None  # created in start()
         self._install_default_middleware()
+
+    def on_startup(self, func: Callable) -> Callable:
+        """Register a (possibly async) callable to run inside ``start()``
+        before servers accept traffic — e.g. model warmup so the first
+        request never pays a TPU compile. Returns ``func`` (decorator use)."""
+        self._startup_hooks.append(func)
+        return func
 
     # -- middleware chain (httpServer.go:24-30 order) -----------------------
     def _install_default_middleware(self) -> None:
@@ -266,9 +274,12 @@ class App:
         openapi_path = os.path.join("static", "openapi.json")
         if os.path.isfile(openapi_path):
             from gofr_tpu.openapi import make_openapi_handlers
-            spec_handler, ui_handler = make_openapi_handlers(openapi_path)
+            spec_handler, ui_handler, asset_handler = \
+                make_openapi_handlers(openapi_path)
             self.router.add("GET", "/.well-known/openapi.json", spec_handler)
             self.router.add("GET", "/.well-known/swagger", ui_handler)
+            self.router.add("GET", "/.well-known/swagger/{asset}",
+                            asset_handler)
 
     async def _metrics_dispatch(self, request: Request):
         if request.path in ("/metrics", "/"):
@@ -310,6 +321,11 @@ class App:
     async def start(self) -> None:
         self._shutdown = asyncio.Event()
         self._register_default_routes()
+
+        for hook in self._startup_hooks:
+            result = hook()
+            if asyncio.iscoroutine(result):
+                await result
 
         # dynamic batcher on the serving loop (north star: coalesce
         # concurrent requests into one XLA execute)

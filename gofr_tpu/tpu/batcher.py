@@ -74,9 +74,18 @@ class DynamicBatcher:
             batch = jax.tree.map(
                 lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
                 *examples)
-            # device step off-loop: next batch accumulates while this runs
-            result = await loop.run_in_executor(
-                None, self.executor.predict, name, batch)
+            if getattr(self.executor, "is_warm", None) \
+                    and self.executor.is_warm(name, len(examples)):
+                # warm path: enqueue H2D + execute right now on the loop
+                # (both async in JAX), sync off-loop. Batch N+1's transfer
+                # rides under batch N's execute — H2D/compute overlap.
+                handle = self.executor.dispatch(name, batch)
+                result = await loop.run_in_executor(
+                    None, self.executor.fetch, handle)
+            else:
+                # cold path (compile) stays off-loop entirely
+                result = await loop.run_in_executor(
+                    None, self.executor.predict, name, batch)
             for i, future in enumerate(futures):
                 if not future.done():  # request may have timed out/gone
                     future.set_result(

@@ -127,10 +127,51 @@ class Executor:
                 inputs, [l[i:i + bucket] for l in leaves]))
                 for i in range(0, n, bucket)]
             return self._tree_concat(outs)
+        return self.fetch(self._dispatch(model, name, inputs, leaves,
+                                         n, bucket))
+
+    # -- async dispatch/fetch split (H2D/compute overlap) --------------------
+    def is_warm(self, name: str, n: int) -> bool:
+        """True when a batch of ``n`` hits an already-compiled bucket, i.e.
+        ``dispatch`` is cheap enough to run on the event loop."""
+        model = self._models.get(name)
+        if model is None:
+            return False
+        bucket = next((b for b in model.buckets if b >= n), None)
+        return bucket is not None and bucket in model.compiled
+
+    def dispatch(self, name: str, inputs: Any):
+        """Asynchronous half of ``predict``: pad, *start* the H2D transfer
+        and enqueue the XLA execute without syncing. Returns an opaque
+        handle for ``fetch``. Double-buffering falls out: dispatching batch
+        N+1 while batch N computes rides the transfer stream under the
+        running execute, so the device never idles waiting on PCIe/relay."""
+        model = self._models.get(name)
+        if model is None:
+            raise KeyError(f"tpu model {name!r} not registered "
+                           f"(have {list(self._models)})")
+        leaves = self._leaves(inputs)
+        n = leaves[0].shape[0]
+        bucket = next((b for b in model.buckets if b >= n), None)
+        if bucket is None:
+            raise ValueError(
+                f"batch {n} exceeds largest bucket {model.buckets[-1]}; "
+                "use predict() which splits oversized batches")
+        return self._dispatch(model, name, inputs, leaves, n, bucket)
+
+    def _dispatch(self, model: _Model, name: str, inputs: Any, leaves,
+                  n: int, bucket: int):
         start = time.perf_counter()
         padded = self._tree_unflatten(
             inputs, [_pad_batch(np.asarray(l), bucket) for l in leaves])
-        out = self._execute(model, padded, bucket)
+        out = self._execute_async(model, padded, bucket)
+        return (name, out, n, start)
+
+    def fetch(self, handle) -> Any:
+        """Sync a ``dispatch`` handle: wait for the execute, record metrics,
+        slice off the padding."""
+        name, out, n, start = handle
+        out = self._jax.block_until_ready(out)
         elapsed = time.perf_counter() - start
         self.metrics.record_histogram("app_tpu_execute", elapsed, model=name)
         self.metrics.record_histogram("app_tpu_batch_size", float(n),
@@ -139,6 +180,12 @@ class Executor:
         return self._jax.tree.map(lambda l: np.asarray(l)[:n], out)
 
     def _execute(self, model: _Model, padded: Any, bucket: int) -> Any:
+        return self._jax.block_until_ready(
+            self._execute_async(model, padded, bucket))
+
+    def _execute_async(self, model: _Model, padded: Any, bucket: int) -> Any:
+        """Enqueue H2D + execute; returns un-synced device arrays (JAX async
+        dispatch)."""
         compiled = model.compiled.get(bucket)
         if compiled is None:
             with model.lock:
@@ -152,8 +199,7 @@ class Executor:
                     self.logger.info(
                         "tpu: compiled %s bucket=%d in %.1fs", model.name,
                         bucket, time.perf_counter() - t0)
-        out = compiled(model.params, self._constrain(padded))
-        return self._jax.block_until_ready(out)
+        return compiled(model.params, self._constrain(padded))
 
     def _constrain(self, inputs: Any):
         jax = self._jax

@@ -20,9 +20,16 @@ across v5e-8, KV-cache in HBM ... continuous batching on the generate loop"
   tick (``lax.scan`` inside one program, K chosen adaptively from a
   compiled ladder up to ``steps_per_tick``). Requests join and leave
   mid-flight without recompiles or barriers.
-- The loop is *pipelined*: tick N+1 is dispatched (JAX async dispatch)
-  before tick N's tokens are fetched to host, so host-side bookkeeping
-  and the device never wait on each other.
+- The loop is *pipelined M deep*: up to ``max_inflight_ticks`` ticks are
+  dispatched (JAX async dispatch) before the oldest tick's tokens are
+  fetched to host, and every fetch runs concurrently in its own worker
+  thread. Device→host token fetches therefore overlap both the device
+  compute AND each other — on hosts where the D2H round trip rivals the
+  tick compute time (PCIe under load; this container's relay at ~100 ms
+  RTT), fetch latency amortizes across M ticks instead of serializing
+  the loop. Tokens always publish in dispatch order (FIFO), so per-slot
+  ordering and eos/budget semantics are unchanged; per-slot ``inflight``
+  accounting keeps speculative depth from overshooting any budget.
 - Inactive slots are frozen in the decode executable (cache_len does not
   advance), so an idle slot's window never grows between requests.
 - Per-slot host state (remaining budget, eos, emitted tokens, generation
@@ -35,6 +42,7 @@ executable ladders are warm.
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -56,11 +64,24 @@ class _Slot:
         self.inflight = 0     # tokens dispatched on device, not yet published
 
 
+class _Fetch:
+    """One dispatched device op whose tokens are being fetched to host in a
+    worker thread. ``kind`` is "prefill" (payload: [(slot, gen, row)]) or
+    "tick" (payload: [(slot, gen)])."""
+    __slots__ = ("task", "kind", "payload")
+
+    def __init__(self, task, kind: str, payload):
+        self.task = task
+        self.kind = kind
+        self.payload = payload
+
+
 class GenerationEngine:
     def __init__(self, cfg, params, max_slots: int = 8,
                  max_len: Optional[int] = None,
                  prompt_buckets=DEFAULT_PROMPT_BUCKETS,
                  steps_per_tick: int = 1,
+                 max_inflight_ticks: int = 2,
                  mesh=None,
                  logger=None, metrics=None):
         import jax
@@ -87,19 +108,26 @@ class GenerationEngine:
         self._k_ladder = [1]
         while self._k_ladder[-1] * 2 <= self.steps_per_tick:
             self._k_ladder.append(self._k_ladder[-1] * 2)
-        # admission-count ladder: 1,2,4,... up to max_slots
+        # admission-count ladder: 1,2,4,... up to max_slots. max_slots is
+        # always the top rung even when it is not a power of two (e.g.
+        # GENERATE_SLOTS=12 or dp-rounding 9→12): _admit_pending can group
+        # up to max_slots same-bucket requests and must find a rung.
         self._n_ladder = [1]
         while self._n_ladder[-1] * 2 <= max_slots:
             self._n_ladder.append(self._n_ladder[-1] * 2)
+        if self._n_ladder[-1] != max_slots:
+            self._n_ladder.append(max_slots)
         self.logger = logger
         self.metrics = metrics
 
         if mesh is not None:
+            from gofr_tpu.ops.quant import quantized_specs
             from gofr_tpu.parallel.sharding import (
                 llama_cache_specs, llama_param_specs, prune_specs,
                 shard_pytree)
+            specs = quantized_specs(llama_param_specs(), params)
             self.params = shard_pytree(
-                params, mesh, prune_specs(llama_param_specs(), mesh))
+                params, mesh, prune_specs(specs, mesh))
             cache = llama.init_cache(cfg, max_slots, self.max_len)
             self.cache = shard_pytree(
                 cache, mesh, prune_specs(llama_cache_specs(), mesh))
@@ -117,6 +145,9 @@ class GenerationEngine:
         self._wake = asyncio.Event()
         self._steps = 0
         self._prefills = 0
+        self.max_inflight_ticks = max(1, int(max_inflight_ticks))
+        self._publishq: "deque" = deque()   # FIFO of _Fetch entries
+        self._ticks_inflight = 0
 
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._insert_fns: Dict[Tuple[int, int], Any] = {}
@@ -191,15 +222,29 @@ class GenerationEngine:
             self._decode_fns[k_steps] = fn
         return fn
 
-    async def warmup(self, prompt_counts: Tuple[int, ...] = (1,)) -> None:
+    async def warmup(self, prompt_counts: Tuple[int, ...] = (1,),
+                     ks: Optional[Tuple[int, ...]] = None) -> None:
         """Pre-compile the decode ladder and prefill/insert executables so
-        the serving path never traces (executor.warmup analog)."""
+        the serving path never traces (executor.warmup analog). ``ks``
+        restricts which decode rungs to precompile (default: the whole
+        ladder); an unwarmed rung still compiles lazily off-loop if the
+        scheduler ever picks it.
+
+        Must run before ``start()``: warmup mutates cache/cache_len/
+        last_token through donated-buffer executables, and racing the
+        engine loop would dispatch against invalidated arrays."""
+        if self._task is not None:
+            raise RuntimeError(
+                "warmup() must be called before start(): it mutates engine "
+                "device state outside the engine loop")
         jnp = self._jnp
         loop = asyncio.get_running_loop()
+        rungs = self._k_ladder if ks is None \
+            else [k for k in self._k_ladder if k in ks]
 
         def compile_all():
             active = jnp.zeros((self.max_slots,), bool)
-            for k in self._k_ladder:
+            for k in rungs:
                 tokens, cache, cache_len = self._decode_fn(k)(
                     self.params, self.last_token, self.cache, self.cache_len,
                     active)
@@ -282,44 +327,115 @@ class GenerationEngine:
     # -- engine loop --------------------------------------------------------
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()
-        prev_tick = None      # (tokens_dev (K,B), [(slot_idx, gen)])
-        first_fetches: List[Tuple[Any, List[Tuple[int, int, int]]]] = []
         while True:
-            # 1. batched admission of everything pending (up to free slots)
-            first_fetches.extend(await self._admit_pending(loop))
+            try:
+                await self._loop_body(loop)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:     # noqa: BLE001 — engine must not
+                # die silently: fail every outstanding caller and keep
+                # serving (handler panic-isolation analog).
+                if self.logger is not None:
+                    self.logger.error("generation engine tick failed: %r",
+                                      exc)
+                self._fail_outstanding(exc)
+                self._publishq.clear()
+                self._ticks_inflight = 0
+                # the failed executable may have consumed donated buffers
+                # (cache/cache_len/last_token donate_argnums) — the old
+                # handles are poisoned, so rebuild device state or every
+                # later dispatch re-raises the same buffer error
+                try:
+                    self._reset_device_state()
+                except Exception as reset_exc:  # noqa: BLE001
+                    if self.logger is not None:
+                        self.logger.error(
+                            "engine device-state reset failed: %r",
+                            reset_exc)
 
-            if (self.active_slots == 0 and prev_tick is None
-                    and not first_fetches):
-                if self._pending.empty():
-                    self._wake.clear()
-                    await self._wake.wait()
-                continue
+    def _reset_device_state(self) -> None:
+        """Reinitialize cache/cache_len/last_token (fresh device buffers,
+        original shardings). Loses in-progress KV state — callers were
+        already failed by _fail_outstanding."""
+        jnp, llama = self._jnp, self._llama
+        cache = llama.init_cache(self.cfg, self.max_slots, self.max_len)
+        if self.mesh is not None:
+            from gofr_tpu.parallel.sharding import (
+                llama_cache_specs, prune_specs, shard_pytree)
+            self.cache = shard_pytree(
+                cache, self.mesh, prune_specs(llama_cache_specs(),
+                                              self.mesh))
+        else:
+            self.cache = self._jax.device_put(cache)
+        self.cache_len = jnp.zeros((self.max_slots,), jnp.int32)
+        self.last_token = jnp.zeros((self.max_slots,), jnp.int32)
+        self._mask_key = None
 
-            # 2. dispatch the next decode tick before touching host results
-            #    (pipelining: the device runs while we do bookkeeping and
-            #    fetch the *previous* tick's tokens)
-            cur_tick = None
-            if self.active_slots > 0:
-                cur_tick = await self._dispatch_tick(loop)
+    def _fail_outstanding(self, exc: BaseException) -> None:
+        """Propagate a loop failure to every waiting caller and reset the
+        slot table so the engine can keep admitting fresh requests."""
+        for slot_idx, slot in enumerate(self._slots):
+            if slot.active:
+                slot.active = False
+                slot.gen += 1
+                slot.inflight = 0
+                if slot.future is not None and not slot.future.done():
+                    slot.future.set_exception(exc)
+                if slot_idx not in self._free:
+                    self._free.append(slot_idx)
+        while not self._pending.empty():
+            *_, future = self._pending.get_nowait()
+            if not future.done():
+                future.set_exception(exc)
 
-            # 3. publish prefill first-tokens in admission order
-            for first_dev, claimed in first_fetches:
-                first_host = await loop.run_in_executor(
-                    None, np.asarray, first_dev)
-                for slot_idx, gen, row in claimed:
-                    self._push_tokens(slot_idx, gen, [int(first_host[row])])
-            first_fetches = []
+    async def _loop_body(self, loop) -> None:
+        q = self._publishq
+        # 1. batched admission of everything pending (up to free slots);
+        #    each prefill's first-token fetch starts concurrently
+        for first_dev, claimed in await self._admit_pending(loop):
+            q.append(_Fetch(loop.run_in_executor(None, np.asarray,
+                                                 first_dev),
+                            "prefill", claimed))
 
-            # 4. fetch + publish the previous tick's tokens
-            if prev_tick is not None:
-                tokens_dev, snapshot = prev_tick
-                tokens_host = await loop.run_in_executor(
-                    None, np.asarray, tokens_dev)
-                for slot_idx, gen in snapshot:
-                    self._push_tokens(slot_idx, gen,
-                                      [int(t) for t in
-                                       tokens_host[:, slot_idx]])
-            prev_tick = cur_tick
+        # 2. dispatch the next decode tick(s) up to the pipeline depth;
+        #    its token fetch starts immediately in its own worker thread
+        dispatched = False
+        if (self.active_slots > 0
+                and self._ticks_inflight < self.max_inflight_ticks):
+            tick = await self._dispatch_tick(loop)
+            if tick is not None:
+                tokens_dev, snapshot = tick
+                self._ticks_inflight += 1
+                q.append(_Fetch(loop.run_in_executor(None, np.asarray,
+                                                     tokens_dev),
+                                "tick", snapshot))
+                dispatched = True
+
+        if not q:
+            if self.active_slots == 0 and self._pending.empty():
+                self._wake.clear()
+                await self._wake.wait()
+            return
+
+        # 3. publish in dispatch order (per-slot token order). Block on the
+        #    oldest fetch only when the pipeline can't go deeper; then
+        #    drain whatever else already completed.
+        if not dispatched or self._ticks_inflight >= self.max_inflight_ticks:
+            entry = q.popleft()
+            self._publish(entry, await entry.task)
+        while q and q[0].task.done():
+            entry = q.popleft()
+            self._publish(entry, entry.task.result())
+
+    def _publish(self, entry: _Fetch, host) -> None:
+        if entry.kind == "prefill":
+            for slot_idx, gen, row in entry.payload:
+                self._push_tokens(slot_idx, gen, [int(host[row])])
+        else:
+            self._ticks_inflight -= 1
+            for slot_idx, gen in entry.payload:
+                self._push_tokens(slot_idx, gen,
+                                  [int(t) for t in host[:, slot_idx]])
 
     async def _admit_pending(self, loop):
         """Drain the queue into slots; one batched prefill dispatch per
@@ -382,26 +498,34 @@ class GenerationEngine:
 
     async def _dispatch_tick(self, loop):
         """Choose K adaptively, dispatch one decode executable, return
-        (device tokens handle, active snapshot) without syncing. Skips the
-        tick (returns None) when every active slot's budget is already
-        covered by in-flight tokens — no speculative overshoot."""
+        (device tokens handle, active snapshot) without syncing.
+
+        Slots whose budget is already covered by in-flight tokens are
+        excluded from this tick (frozen in the mask) rather than stalling
+        everyone: one nearly-finished slot must not serialize the rest.
+        Returns None only when *no* slot wants more tokens. K drops to 1
+        only when a pending request could actually be admitted next
+        iteration (pending non-empty AND a free slot exists) — under
+        saturation there is nothing to admit, so fused-K ticks continue."""
         jnp = self._jnp
-        min_wanted = min(slot.remaining - slot.inflight
-                         for slot in self._slots if slot.active)
-        if min_wanted <= 0:
+        eligible = [(slot_idx, slot)
+                    for slot_idx, slot in enumerate(self._slots)
+                    if slot.active and slot.remaining > slot.inflight]
+        if not eligible:
             return None
+        min_wanted = min(slot.remaining - slot.inflight
+                         for _, slot in eligible)
         k = 1
-        if self._pending.empty():
+        if self._pending.empty() or not self._free:
             for rung in self._k_ladder:
                 if rung <= min_wanted:
                     k = rung
         active = np.zeros((self.max_slots,), bool)
         snapshot = []
-        for slot_idx, slot in enumerate(self._slots):
-            if slot.active:
-                active[slot_idx] = True
-                slot.inflight += k
-                snapshot.append((slot_idx, slot.gen))
+        for slot_idx, slot in eligible:
+            active[slot_idx] = True
+            slot.inflight += k
+            snapshot.append((slot_idx, slot.gen))
         # keep the mask device-resident: re-upload only when the active set
         # changed (H2D through a relay costs ~10ms; most ticks are stable)
         key = active.tobytes()
