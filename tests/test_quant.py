@@ -1,0 +1,141 @@
+"""int8 weight-only quantization (ops/quant): numerics, llama integration,
+sharding-spec expansion, memory halving."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import llama
+from gofr_tpu.ops import quant
+
+
+def test_quantize_roundtrip_error_bounded():
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (64, 32), jnp.float32) * 0.2
+    qw = quant.quantize(w)
+    assert qw["q"].dtype == jnp.int8
+    assert qw["s"].shape == (1, 32)
+    # per-channel absmax/127 step size bounds elementwise error by s/2
+    # (in fp32: dequantize()'s bf16 output adds its own ulp on top)
+    back = np.asarray(qw["q"], np.float32) * np.asarray(qw["s"])
+    step = np.asarray(qw["s"])
+    assert np.all(np.abs(back - np.asarray(w)) <= step * 0.51 + 1e-6)
+    bf16 = np.asarray(quant.dequantize(qw), np.float32)
+    np.testing.assert_allclose(bf16, back, rtol=8e-3)
+
+
+def test_qmm_matches_matmul():
+    key = jax.random.PRNGKey(1)
+    w = jax.random.normal(key, (4, 16, 8), jnp.float32) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 16), jnp.float32)
+    plain = np.asarray(x @ w[1])
+    qw = quant.quantize(w)
+    ours = np.asarray(quant.qmm(x, {"q": qw["q"][1], "s": qw["s"][1]}))
+    np.testing.assert_allclose(ours, plain, atol=0.05, rtol=0.05)
+    # unquantized passthrough
+    np.testing.assert_allclose(np.asarray(quant.qmm(x, w[1])), plain,
+                               rtol=1e-6)
+
+
+def test_zero_channel_quantizes_without_nan():
+    w = jnp.zeros((8, 4), jnp.float32)
+    qw = quant.quantize(w)
+    assert np.all(np.asarray(qw["q"]) == 0)
+    assert np.all(np.isfinite(np.asarray(qw["s"])))
+    out = quant.qmm(jnp.ones((2, 8)), qw)
+    assert np.all(np.asarray(out) == 0)
+
+
+def test_llama_quantized_logits_close():
+    """Weight-only int8 must track the full-precision forward closely on
+    the tiny model (relative logit error, not exact match)."""
+    cfg = llama.config("tiny", dtype=jnp.float32)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    qparams = llama.quantize_params(params)
+    # structure: matmul weights became {"q","s"}; norms untouched
+    assert quant.is_quantized(qparams["layers"]["wq"])
+    assert quant.is_quantized(qparams["lm_head"])
+    assert not quant.is_quantized(qparams["layers"]["attn_norm"])
+    assert qparams["layers"]["wq"]["q"].dtype == jnp.int8
+
+    tokens = jnp.asarray([[5, 17, 200, 3, 90]], jnp.int32)
+    full = np.asarray(llama.forward(params, cfg, tokens))
+    quantized = np.asarray(llama.forward(qparams, cfg, tokens))
+    rel = (np.linalg.norm(quantized - full)
+           / max(np.linalg.norm(full), 1e-9))
+    assert rel < 0.05, f"relative logit error {rel:.4f}"
+    # decode path too
+    cache = llama.init_cache(cfg, 1, 32)
+    _, qcache, qlen = llama.prefill(qparams, cfg, tokens, cache)
+    logits, _, _ = llama.decode_step(
+        qparams, cfg, jnp.asarray([7], jnp.int32), qcache, qlen)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_quantized_weight_bytes_halve():
+    cfg = llama.config("small")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+
+    def nbytes(tree):
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree.leaves(tree))
+
+    full = nbytes(params["layers"])
+    quantized = nbytes(llama.quantize_params(params)["layers"])
+    # int8 vs bf16 on the big matrices → close to half (scales are small)
+    assert quantized < 0.56 * full
+
+
+def test_quantized_specs_expand():
+    from jax.sharding import PartitionSpec as P
+
+    from gofr_tpu.parallel.sharding import llama_param_specs
+    cfg = llama.config("tiny")
+    qparams = llama.quantize_params(llama.init(cfg, jax.random.PRNGKey(0)))
+    specs = quant.quantized_specs(llama_param_specs(), qparams)
+    assert specs["layers"]["wq"]["q"] == P(None, None, "tp")
+    # scale's in-features dim is size 1: never sharded
+    assert specs["layers"]["wq"]["s"] == P(None, None, "tp")
+    assert specs["layers"]["wo"]["q"] == P(None, "tp", None)
+    assert specs["layers"]["wo"]["s"] == P(None, None, None)
+    assert specs["lm_head"]["q"] == P(None, "tp")
+    assert specs["lm_head"]["s"] == P(None, "tp")
+    assert specs["layers"]["attn_norm"] == P(None, None)
+    assert specs["tok_emb"] == P(None, None)
+
+
+def test_quantized_engine_generates_on_mesh():
+    """End to end: int8 params through the mesh GenerationEngine —
+    BASELINE.md config 5 (7B int8 on tp) in tiny geometry."""
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.parallel import make_mesh
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    cfg = llama.config("tiny", dtype=jnp.float32)
+    qparams = llama.quantize_params(llama.init(cfg, jax.random.PRNGKey(0)))
+    container = new_mock_container()
+    mesh = make_mesh({"dp": 2, "tp": 2})
+
+    def run(mesh):
+        engine = GenerationEngine(cfg, qparams, max_slots=4, max_len=64,
+                                  prompt_buckets=(8,), steps_per_tick=2,
+                                  mesh=mesh, logger=container.logger,
+                                  metrics=container.metrics)
+
+        async def main():
+            await engine.start()
+            outs = await asyncio.gather(*[
+                engine.generate([i + 1, i + 2], max_new_tokens=4)
+                for i in range(4)])
+            await engine.stop()
+            return outs
+
+        return asyncio.run(main())
+
+    sharded = run(mesh)
+    single = run(None)
+    assert sharded == single
+    assert all(len(o) == 4 for o in sharded)
