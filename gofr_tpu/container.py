@@ -113,6 +113,10 @@ class Container:
                               (0.00005, 0.0001, 0.0003, 0.001, 0.003))
         metrics.new_histogram("app_sql_stats", "sql query time (s)",
                               (0.00005, 0.0001, 0.0005, 0.001, 0.01))
+        # pushed by the SQL maintenance loop (sql.go:189-202 analog)
+        metrics.new_gauge("app_sql_open_connections", "SQL connection up 0/1")
+        metrics.new_gauge("app_sql_inuse_connections",
+                          "SQL statements currently executing")
         metrics.new_counter("app_pubsub_publish_total_count", "publish attempts")
         metrics.new_counter("app_pubsub_publish_success_count", "publishes ok")
         metrics.new_counter("app_pubsub_subscribe_total_count", "receive attempts")

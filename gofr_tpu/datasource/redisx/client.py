@@ -163,7 +163,7 @@ class RedisClient(_BaseRedis):
         while b"\r\n" not in self._buffer:
             chunk = self._sock.recv(65536)
             if not chunk:
-                raise RedisError("connection closed")
+                raise ConnectionError("redis connection closed")
             self._buffer += chunk
         line, self._buffer = self._buffer.split(b"\r\n", 1)
         return line
@@ -172,7 +172,7 @@ class RedisClient(_BaseRedis):
         while len(self._buffer) < n + 2:
             chunk = self._sock.recv(65536)
             if not chunk:
-                raise RedisError("connection closed")
+                raise ConnectionError("redis connection closed")
             self._buffer += chunk
         data, self._buffer = self._buffer[:n], self._buffer[n + 2:]
         return data
@@ -200,10 +200,15 @@ class RedisClient(_BaseRedis):
         return self._read_reply()
 
     def command(self, *parts) -> Any:
+        # Reconnect-and-reissue ONLY on transport failure (dead socket —
+        # OSError covers ConnectionError). A server error reply (``-ERR``,
+        # WRONGTYPE…) raises RedisError and must NOT retry: the connection
+        # is healthy and reissuing a non-idempotent command (INCR, LPUSH)
+        # would double-apply it.
         with self._lock:
             try:
                 return self._exchange(*parts)
-            except (OSError, RedisError):
+            except OSError:
                 self._connect()  # one reconnect attempt then surface
                 return self._exchange(*parts)
 
