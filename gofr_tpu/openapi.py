@@ -1,16 +1,35 @@
-"""OpenAPI serving: spec + self-contained doc UI.
+"""OpenAPI serving: spec + embedded swagger UI.
 
 Capability parity with ``pkg/gofr/swagger.go`` (OpenAPIHandler serves
 ./static/openapi.json 22-33; SwaggerUIHandler 36-55 serves an embedded UI;
 wired under /.well-known/* when the file exists, gofr.go:137-141). The
-reference embeds the swagger-ui bundle; this image is zero-egress, so the
-UI is an original single-file renderer (vanilla JS over the spec JSON).
+full swagger-ui dist (third-party, Apache-2.0 — see
+``gofr_tpu/static/README.md``) is vendored the way the reference embeds
+it, so the UI works air-gapped with no CDN; a minimal original fallback
+renderer serves if the vendored assets are ever stripped from the
+install.
 """
 
 from __future__ import annotations
 
 import json
 import os
+
+_STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+_SWAGGER_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>API docs</title>
+<link rel="stylesheet" href="swagger/swagger-ui.css">
+</head><body>
+<div id="swagger-ui"></div>
+<script src="swagger/swagger-ui-bundle.js"></script>
+<script>
+window.ui = SwaggerUIBundle({
+  url: 'openapi.json',
+  dom_id: '#swagger-ui',
+  presets: [SwaggerUIBundle.presets.apis],
+  layout: 'BaseLayout',
+});
+</script></body></html>"""
 
 _UI_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>API docs</title><style>
@@ -44,8 +63,32 @@ fetch('openapi.json').then(r=>r.json()).then(spec=>{
 </script></body></html>"""
 
 
+_ASSET_TYPES = {"swagger-ui-bundle.js": "application/javascript",
+                "swagger-ui.css": "text/css"}
+_asset_cache: dict = {}
+
+
+def _load_assets() -> dict:
+    """Read the vendored dist once per process — the files are immutable
+    for the process lifetime (~1.6 MB total)."""
+    if not _asset_cache:
+        for name in _ASSET_TYPES:
+            path = os.path.join(_STATIC_DIR, name)
+            if os.path.isfile(path):
+                with open(path, "rb") as handle:
+                    _asset_cache[name] = handle.read()
+        _asset_cache.setdefault("", b"")  # sentinel: scan happened
+    return _asset_cache
+
+
+def swagger_assets_present() -> bool:
+    return all(name in _load_assets() for name in _ASSET_TYPES)
+
+
 def make_openapi_handlers(spec_path: str):
-    """(spec_handler, ui_handler) wire pair for /.well-known routes."""
+    """(spec_handler, ui_handler, asset_handler) wire trio for the
+    /.well-known routes. ``asset_handler`` serves the vendored swagger-ui
+    dist under /.well-known/swagger/<asset>."""
 
     async def spec_handler(request):
         try:
@@ -57,8 +100,18 @@ def make_openapi_handlers(spec_path: str):
                 b'{"error":"openapi.json missing or invalid"}'
         return 200, {"Content-Type": "application/json"}, body
 
-    async def ui_handler(request):
-        return 200, {"Content-Type": "text/html; charset=utf-8"}, \
-            _UI_HTML.encode()
+    ui_html = (_SWAGGER_HTML if swagger_assets_present()
+               else _UI_HTML).encode()
 
-    return spec_handler, ui_handler
+    async def ui_handler(request):
+        return 200, {"Content-Type": "text/html; charset=utf-8"}, ui_html
+
+    async def asset_handler(request):
+        name = os.path.basename(request.path_params.get("asset", ""))
+        body = _load_assets().get(name) if name in _ASSET_TYPES else None
+        if not body:
+            return 404, {"Content-Type": "text/plain"}, b"not found"
+        return 200, {"Content-Type": _ASSET_TYPES[name],
+                     "Cache-Control": "public, max-age=86400"}, body
+
+    return spec_handler, ui_handler, asset_handler

@@ -265,7 +265,20 @@ def test_openapi_routes(tmp_path, monkeypatch):
             assert got.json()["info"]["title"] == "T"
             ui = await http_request(port, "GET", "/.well-known/swagger")
             assert ui.status == 200
-            assert b"API documentation" in ui.body
+            # vendored swagger-ui dist is embedded (reference
+            # static/files.go parity): the page loads the real bundle...
+            assert b"SwaggerUIBundle" in ui.body
+            js = await http_request(
+                port, "GET", "/.well-known/swagger/swagger-ui-bundle.js")
+            assert js.status == 200 and len(js.body) > 100_000
+            assert js.headers["content-type"] == "application/javascript"
+            css = await http_request(
+                port, "GET", "/.well-known/swagger/swagger-ui.css")
+            assert css.status == 200 and b"swagger-ui" in css.body
+            # ...and path traversal in the asset name is rejected
+            bad = await http_request(
+                port, "GET", "/.well-known/swagger/..%2Fopenapi.py")
+            assert bad.status == 404
     run(main())
 
 
