@@ -56,9 +56,15 @@ def _conv_params(key, kh, kw, c_in, c_out, dtype):
     }
 
 
-def _conv(x, p, stride=1, padding="SAME"):
+def _conv(x, p, stride=1):
+    # explicit symmetric k//2 padding (torch semantics), NOT "SAME": with
+    # stride 2 on even inputs SAME pads asymmetrically (lo=k//2-1), which
+    # shifts every strided conv window by one pixel vs the torchvision
+    # weights this model must reproduce (tests/test_convert.py parity)
+    kh, kw = p["w"].shape[:2]
     y = lax.conv_general_dilated(
-        x, p["w"], window_strides=(stride, stride), padding=padding,
+        x, p["w"], window_strides=(stride, stride),
+        padding=[(kh // 2, kh // 2), (kw // 2, kw // 2)],
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
     return y * p["scale"] + p["shift"]
 
@@ -113,8 +119,9 @@ def apply(params: Dict[str, Any], cfg: ResNetConfig,
     """images (B, H, W, 3) → logits (B, num_classes) fp32."""
     x = images.astype(cfg.dtype)
     x = jax.nn.relu(_conv(x, params["stem"], stride=2))
+    # 3x3/2 max-pool, symmetric pad 1 (torch semantics — see _conv)
     x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
-                          "SAME")
+                          [(0, 0), (1, 1), (1, 1), (0, 0)])
     for stage_idx, blocks in enumerate(params["stages"]):
         for block_idx, block in enumerate(blocks):
             stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
