@@ -1,41 +1,92 @@
-"""Headline bench: ResNet-50 classify throughput through the TPU executor.
+"""Headline bench: ResNet-50 classify + Llama decode on one TPU chip.
 
-North-star target (BASELINE.md config 2): ≥1000 req/s/chip on the classify
-path. Measures steady-state images/sec of the compiled classify step on one
-chip at the serving batch size, amortized over a pipelined window (the way
-the dynamic batcher drives it).
+North-star target (BASELINE.md config 2): ≥1000 req/s/chip AND p99 < 10 ms
+on the classify path. This bench measures all of it honestly:
 
-Input tensors are device-resident: this container reaches its TPU through
-the axon relay, whose H2D path measures ~35 MB/s under load — a tunnel
-artifact ~500x below a real v5e host's PCIe, which would move a uint8
-batch in ~1 ms. The relay-included number is reported alongside as
-``value_with_relay_h2d`` for transparency.
+1. **Device-resident steady state** — the compiled classify step at the
+   serving batch (MXU utilisation ceiling), with MFU computed from XLA's
+   own cost analysis against the chip's bf16 peak.
+2. **Operating point** — the largest batch whose device latency fits a
+   p99 < 10 ms budget, and the per-chip req/s at that point.
+3. **Closed-loop HTTP** — real requests through router → middleware →
+   handler → dynamic batcher → executor (the path BASELINE.md names),
+   reporting measured p50/p99 for /hello (framework overhead, config 1)
+   and /classify.
+4. **Pipelined host-input throughput** — double-buffered H2D (dispatch
+   batch N+1's transfer under batch N's execute). This container reaches
+   its TPU through the axon relay (~35 MB/s H2D, ~500x below a real v5e
+   host's PCIe), so the relay-included number is a tunnel artifact,
+   reported for transparency as ``value_with_relay_h2d``.
+5. **Llama continuous-batching decode** — aggregate tok/s through the
+   generation engine, post-warmup (the executable ladder is precompiled;
+   round 2 accidentally timed four TPU compiles).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 
 import numpy as np
 
-TARGET_REQ_S = 1000.0  # BASELINE.md config 2
+TARGET_REQ_S = 1000.0   # BASELINE.md config 2
+TARGET_P99_MS = 10.0
+
+# bf16 peak FLOP/s by PJRT device_kind (public spec sheets)
+PEAK_BF16 = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+}
 
 
 def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+
+    resnet_stats = _resnet_bench(on_tpu)
+    http_stats = _http_bench(on_tpu)
+    llama_tok_s = _llama_decode_bench(on_tpu)
+    llama7b = _llama7b_int8_bench(on_tpu)
+
+    req_per_s = resnet_stats.pop("req_per_s")
+    print(json.dumps({
+        "metric": "resnet50_classify_throughput_per_chip",
+        "value": round(req_per_s, 1),
+        "unit": "req/s",
+        "vs_baseline": round(req_per_s / TARGET_REQ_S, 3),
+        "platform": platform,
+        **resnet_stats,
+        **http_stats,
+        "llama_small_decode_tok_s": llama_tok_s,
+        "llama7b_int8": llama7b,
+    }))
+
+
+def _percentiles(latencies):
+    arr = np.asarray(sorted(latencies))
+    return (round(float(np.percentile(arr, 50)) * 1e3, 2),
+            round(float(np.percentile(arr, 99)) * 1e3, 2))
+
+
+def _resnet_bench(on_tpu: bool) -> dict:
+    """Device-resident steady state + MFU + operating point + pipelined
+    host-input (H2D-overlapped) throughput."""
     import jax
     import jax.numpy as jnp
 
     from gofr_tpu.models import resnet
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform != "cpu"
     batch = 256 if on_tpu else 16
     iters = 20 if on_tpu else 4
 
-    cfg = resnet.config("50")
+    cfg = resnet.config("50" if on_tpu else "tiny")
     params = jax.device_put(resnet.init(cfg, jax.random.PRNGKey(0)))
 
     def classify(p, u8):
@@ -45,42 +96,210 @@ def main() -> None:
     step = jax.jit(classify)
     u8_host = np.ones((batch, cfg.image_size, cfg.image_size, 3), np.uint8)
     u8_dev = jax.device_put(jnp.asarray(u8_host))
-    jax.block_until_ready(step(params, u8_dev))  # compile + warm
+    # one AOT compile serves the warm call, the timed windows AND the
+    # cost analysis (calling step() here would compile the identical
+    # program a second time through the jit cache)
+    compiled = step.lower(params, u8_dev).compile()
+    jax.block_until_ready(compiled(params, u8_dev))  # warm
 
-    def timed_window(arg, n):
+    # XLA's own FLOP count for the serving batch → MFU
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    flops_per_batch = float((cost or {}).get("flops", 0.0))
+    flops_per_image = flops_per_batch / batch
+
+    def timed_window(fn, arg, n):
         t0 = time.perf_counter()
-        outs = [step(params, arg) for _ in range(n)]
+        outs = [fn(params, arg) for _ in range(n)]
         np.asarray(outs[-1])  # real sync through the relay
         jax.block_until_ready(outs)
         return (time.perf_counter() - t0) / n
 
-    timed_window(u8_dev, 3)  # settle
-    per_batch = min(timed_window(u8_dev, iters) for _ in range(3))
+    timed_window(compiled, u8_dev, 3)  # settle
+    per_batch = min(timed_window(compiled, u8_dev, iters) for _ in range(3))
     req_per_s = batch / per_batch
 
-    per_batch_relay = min(timed_window(u8_host, max(2, iters // 4))
+    device_kind = jax.devices()[0].device_kind
+    peak = PEAK_BF16.get(device_kind)
+    mfu = (req_per_s * flops_per_image / peak) if peak else None
+
+    # operating point: largest batch whose device latency fits the p99
+    # budget (batch latency + one queued batch of slack < 10 ms). If even
+    # the smallest batch misses the budget (e.g. per-call dispatch floor
+    # through the relay), the point is still reported with
+    # fits_budget=false — never implied to satisfy the target.
+    op_batch, op_req_s, op_latency_ms, op_fits = None, None, None, False
+    for b in ((32, 64, 128) if on_tpu else (4, 8)):
+        xb = jax.device_put(jnp.asarray(u8_host[:1]).repeat(b, axis=0))
+        jax.block_until_ready(step(params, xb))
+        lat = min(timed_window(step, xb, max(4, iters // 2))
+                  for _ in range(2))
+        # closed-loop p99 ≈ service + one full wait in queue
+        fits = 2.0 * lat * 1e3 < TARGET_P99_MS
+        if fits or op_batch is None:
+            op_batch, op_req_s = b, b / lat
+            op_latency_ms, op_fits = lat * 1e3, fits
+        if not fits:
+            break
+
+    # pipelined host-input: double-buffer the H2D — start batch N+1's
+    # device_put before syncing batch N's output, so transfer rides under
+    # compute instead of serializing with it
+    def timed_pipelined(n):
+        t0 = time.perf_counter()
+        nxt = jax.device_put(u8_host)
+        outs = []
+        for i in range(n):
+            cur = nxt
+            if i + 1 < n:
+                nxt = jax.device_put(u8_host)
+            outs.append(compiled(params, cur))
+        np.asarray(outs[-1])
+        jax.block_until_ready(outs)
+        return (time.perf_counter() - t0) / n
+
+    per_batch_relay = min(timed_pipelined(max(2, iters // 4))
                           for _ in range(2))
 
-    llama_tok_s = _llama_decode_bench(on_tpu)
-
-    print(json.dumps({
-        "metric": "resnet50_classify_throughput_per_chip",
-        "value": round(req_per_s, 1),
-        "unit": "req/s",
-        "vs_baseline": round(req_per_s / TARGET_REQ_S, 3),
-        "platform": platform,
+    return {
+        "req_per_s": req_per_s,
         "batch": batch,
         "batch_latency_ms": round(per_batch * 1e3, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_image": round(flops_per_image / 1e9, 2),
+        "device_kind": device_kind,
+        "operating_point": {
+            "batch": op_batch,
+            "req_per_s": round(op_req_s, 1),
+            "batch_latency_ms": round(op_latency_ms, 2),
+            "p99_budget_ms": TARGET_P99_MS,
+            "fits_budget": op_fits,
+        },
         "value_with_relay_h2d": round(batch / per_batch_relay, 1),
-        "llama_small_decode_tok_s": llama_tok_s,
-    }))
+    }
+
+
+async def _closed_loop(port: int, path: str, body: bytes, method: str,
+                       clients: int, seconds: float,
+                       content_type: str = "application/octet-stream"):
+    """Closed-loop load: ``clients`` persistent connections, each sending
+    back-to-back requests. Returns (req_s, latencies) over the timed
+    window (a warm half-window is discarded)."""
+    head = (f"{method} {path} HTTP/1.1\r\nHost: bench\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+    latencies: list = []
+    warm_until = time.perf_counter() + seconds * 0.4
+    stop_at = warm_until + seconds
+    counted = [0]
+
+    async def one_client():
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            while True:
+                now = time.perf_counter()
+                if now >= stop_at:
+                    return
+                writer.write(head)
+                await writer.drain()
+                header_blob = await reader.readuntil(b"\r\n\r\n")
+                length = 0
+                for line in header_blob.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        length = int(line.split(b":", 1)[1])
+                await reader.readexactly(length)
+                if now >= warm_until:
+                    latencies.append(time.perf_counter() - now)
+                    counted[0] += 1
+        finally:
+            writer.close()
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[one_client() for _ in range(clients)])
+    elapsed = time.perf_counter() - t0 - (warm_until - t0)
+    return counted[0] / elapsed, latencies
+
+
+def _http_bench(on_tpu: bool) -> dict:
+    """Measured p50/p99 through the real serve path (BASELINE.md config 2
+    names router → handler → batcher → executor).
+
+    /hello is config 1 (pure framework overhead, no model). /classify
+    carries a raw uint8 image per request; on this container its H2D goes
+    through the axon relay, so the classify number is relay-bound — the
+    honest full-path figure for *this* harness, not the chip."""
+    import jax
+
+    from gofr_tpu.app import App
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import resnet
+
+    container = new_mock_container({"TPU_ENABLED": "true",
+                                    "TPU_MAX_BATCH": "16",
+                                    "TPU_BATCH_DELAY_MS": "1.0"})
+    app = App(config=container.config, container=container)
+    app.http_port = 0
+    app.metrics_port = 0
+
+    cfg = resnet.config("50" if on_tpu else "tiny")
+    params = resnet.init(cfg, jax.random.PRNGKey(0))
+    shape = (cfg.image_size, cfg.image_size, 3)
+
+    def classify_fn(p, u8):
+        import jax.numpy as jnp
+        x = u8.astype(jnp.bfloat16) / 255.0
+        return resnet.apply(p, cfg, x)
+
+    app.add_model("resnet50", classify_fn, params=params,
+                  buckets=(4, 8, 16))
+
+    def hello(ctx):
+        return {"message": "Hello World!"}
+
+    async def classify(ctx):
+        img = np.frombuffer(ctx.bind(), np.uint8).reshape(shape)
+        logits = await ctx.predict("resnet50", img)
+        return {"label": int(np.argmax(logits))}
+
+    app.get("/hello", hello)
+    app.post("/classify", classify)
+
+    image = np.ones(shape, np.uint8).tobytes()
+    seconds = 4.0 if on_tpu else 1.5
+
+    async def run_loads():
+        await app.start()
+        app.container.tpu.warmup(
+            "resnet50", np.ones(shape, np.uint8))  # compile all buckets
+        port = app._http_server.bound_port
+        hello_req_s, hello_lat = await _closed_loop(
+            port, "/hello", b"", "GET", clients=32, seconds=seconds)
+        cls_req_s, cls_lat = await _closed_loop(
+            port, "/classify", image, "POST", clients=16, seconds=seconds)
+        await app.stop()
+        return hello_req_s, hello_lat, cls_req_s, cls_lat
+
+    hello_req_s, hello_lat, cls_req_s, cls_lat = asyncio.run(run_loads())
+    hello_p50, hello_p99 = _percentiles(hello_lat)
+    cls_p50, cls_p99 = _percentiles(cls_lat)
+    return {
+        "http_hello": {"req_per_s": round(hello_req_s, 1),
+                       "p50_ms": hello_p50, "p99_ms": hello_p99,
+                       "clients": 32},
+        "http_classify": {"req_per_s": round(cls_req_s, 1),
+                          "p50_ms": cls_p50, "p99_ms": cls_p99,
+                          "clients": 16, "max_batch": 16,
+                          "note": "full path incl. relay H2D"},
+        "p50_ms": cls_p50,
+        "p99_ms": cls_p99,
+    }
 
 
 def _llama_decode_bench(on_tpu: bool) -> float:
-    """Secondary metric: aggregate decode tok/s through the
-    continuous-batching engine (8 streams, llama-small, K=8 multi-step)."""
-    import asyncio
-
+    """Aggregate decode tok/s through the continuous-batching engine
+    (8 streams, llama-small, K=8 multi-step), post-warmup steady state."""
     import jax
 
     from gofr_tpu.container import new_mock_container
@@ -93,13 +312,20 @@ def _llama_decode_bench(on_tpu: bool) -> float:
     container = new_mock_container()
     engine = GenerationEngine(cfg, params, max_slots=8, max_len=512,
                               prompt_buckets=(32,), steps_per_tick=8,
+                              max_inflight_ticks=4,
                               logger=container.logger,
                               metrics=container.metrics)
     tokens_each = 64 if on_tpu else 8
 
     async def run_streams():
+        # precompile the full ladder (decode k=1..8, prefill/insert nb=1,8)
+        # BEFORE timing: round 2 shipped 43 tok/s because four TPU compiles
+        # landed inside the timed window.
+        await engine.warmup(prompt_counts=(1, 8))
         await engine.start()
-        await engine.generate(list(range(8)), max_new_tokens=2)  # warm
+        # settle: prefill + one K=8 tick absorbs the one-time first-call
+        # stall after warmup (see _llama7b_int8_bench)
+        await engine.generate(list(range(8)), max_new_tokens=9)
         start = time.perf_counter()
         outs = await asyncio.gather(*[
             engine.generate([i + 1] * 16, max_new_tokens=tokens_each)
@@ -109,6 +335,107 @@ def _llama_decode_bench(on_tpu: bool) -> float:
         return sum(len(o) for o in outs) / elapsed
 
     return round(asyncio.run(run_streams()), 1)
+
+
+def _llama7b_int8_bench(on_tpu: bool):
+    """BASELINE.md config 5 at its stated scale: Llama-2-7B geometry,
+    int8 weight-only (6.7 GB — fits one ~16 GB v5e chip with the KV
+    cache), continuous-batching decode. Weights are random int8 generated
+    on device (the relay H2D would take minutes to upload real weights;
+    decode throughput depends only on layout). Reports aggregate tok/s
+    and the fraction of the HBM-bandwidth roofline achieved."""
+    if not on_tpu:
+        return None
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    cfg = llama.config("7b", max_seq_len=1024)
+    d, f, layer_count = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    qd = cfg.n_heads * cfg.head_dim
+    kvd = cfg.n_kv_heads * cfg.head_dim
+
+    def qrand(seed, *shape):
+        q = jax.jit(
+            lambda k: jax.random.randint(k, shape, -127, 128, jnp.int32)
+            .astype(jnp.int8))(jax.random.PRNGKey(seed))
+        # scales sized so dequantized weights look ~N(0, 1/fan_in)
+        scale = jnp.full(shape[:-2] + (1, shape[-1]),
+                         1.0 / (127.0 * math.sqrt(shape[-2])), jnp.float32)
+        return {"q": q, "s": scale}
+
+    def brand(seed, *shape):
+        fan = shape[-2] if len(shape) > 1 else shape[-1]
+        return jax.jit(
+            lambda k: (jax.random.normal(k, shape, jnp.float32)
+                       / math.sqrt(fan)).astype(jnp.bfloat16)
+        )(jax.random.PRNGKey(seed))
+
+    params = {
+        "tok_emb": brand(0, cfg.vocab_size, d),
+        "layers": {
+            "attn_norm": jnp.ones((layer_count, d), jnp.bfloat16),
+            "wq": qrand(1, layer_count, d, qd),
+            "wk": qrand(2, layer_count, d, kvd),
+            "wv": qrand(3, layer_count, d, kvd),
+            "wo": qrand(4, layer_count, qd, d),
+            "ffn_norm": jnp.ones((layer_count, d), jnp.bfloat16),
+            "w_gate": qrand(5, layer_count, d, f),
+            "w_up": qrand(6, layer_count, d, f),
+            "w_down": qrand(7, layer_count, f, d),
+        },
+        "out_norm": jnp.ones((d,), jnp.bfloat16),
+        "lm_head": qrand(8, d, cfg.vocab_size),
+    }
+
+    container = new_mock_container()
+    engine = GenerationEngine(cfg, params, max_slots=8, max_len=512,
+                              prompt_buckets=(32,), steps_per_tick=8,
+                              max_inflight_ticks=4,
+                              logger=container.logger,
+                              metrics=container.metrics)
+
+    def leaf_bytes(tree):
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree.leaves(tree))
+
+    weight_bytes = leaf_bytes({"layers": params["layers"],
+                               "head": params["lm_head"]})
+    cache_bytes = leaf_bytes(engine.cache)
+    step_bytes = weight_bytes + cache_bytes   # streamed once per step
+    hbm_bw = 819e9                            # v5e spec
+
+    async def run_streams():
+        # budget 65 = 1 prefill + 64 decode = exactly 8 fused K=8 ticks per
+        # slot — only the k=8 rung is ever scheduled, so warm just that
+        await engine.warmup(prompt_counts=(8,), ks=(8,))
+        await engine.start()
+        # settle = 1 prefill + exactly one K=8 tick: absorbs the one-time
+        # first-execution stall (relayout after warmup's donated buffers)
+        # that otherwise lands inside the timed window
+        await asyncio.gather(*[
+            engine.generate([i + 1] * 16, max_new_tokens=9)
+            for i in range(8)])
+        start = time.perf_counter()
+        outs = await asyncio.gather(*[
+            engine.generate([i + 1] * 16, max_new_tokens=65)
+            for i in range(8)])
+        elapsed = time.perf_counter() - start
+        await engine.stop()
+        return sum(len(o) for o in outs) / elapsed
+
+    tok_s = asyncio.run(run_streams())
+    roofline = engine.max_slots * hbm_bw / step_bytes
+    return {"decode_tok_s": round(tok_s, 1),
+            "roofline_tok_s": round(roofline, 1),
+            "roofline_frac": round(tok_s / roofline, 3),
+            "weights_gb": round(weight_bytes / 2**30, 2),
+            "kv_cache_gb": round(cache_bytes / 2**30, 2)}
 
 
 if __name__ == "__main__":
