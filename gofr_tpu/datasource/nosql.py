@@ -184,6 +184,60 @@ class MockClickhouse(_Observed):
         pass
 
 
+class ClickhouseClient(_Observed):
+    """Driver-backed provider for the Exec/Select/AsyncInsert surface
+    (reference clickhouse interface.go:5-9), gated on clickhouse-driver."""
+
+    def __init__(self, config, logger, metrics):
+        super().__init__(logger, metrics, "clickhouse")
+        try:
+            import clickhouse_driver
+        except ImportError as exc:
+            raise NoSQLError(
+                "CLICKHOUSE_HOST configured but clickhouse-driver is not "
+                "installed") from exc
+        host = config.get_or_default("CLICKHOUSE_HOST", "localhost")
+        self._client = clickhouse_driver.Client(
+            host=host, port=config.get_int("CLICKHOUSE_PORT", 9000),
+            user=config.get_or_default("CLICKHOUSE_USER", "default"),
+            password=config.get_or_default("CLICKHOUSE_PASSWORD", ""),
+            database=config.get_or_default("CLICKHOUSE_DB", "default"))
+        logger.info("clickhouse connected %s", host)
+
+    def exec(self, query: str, *args) -> None:
+        start = time.perf_counter()
+        self._client.execute(query, args or None)
+        self._observe(query, start)
+
+    def select(self, entity_class: Optional[Type], query: str,
+               *args) -> List[Any]:
+        start = time.perf_counter()
+        rows, columns = self._client.execute(query, args or None,
+                                             with_column_types=True)
+        out = [dict(zip((name for name, _ in columns), row))
+               for row in rows]
+        self._observe(query, start)
+        return _bind_rows(entity_class, out)
+
+    def async_insert(self, query: str, *args) -> None:
+        # driver exposes async inserts via settings on execute
+        start = time.perf_counter()
+        self._client.execute(query, args or None,
+                             settings={"async_insert": 1,
+                                       "wait_for_async_insert": 0})
+        self._observe(query, start)
+
+    def health_check(self) -> Dict[str, Any]:
+        try:
+            self._client.execute("SELECT 1")
+            return {"status": "UP", "details": {"engine": "clickhouse"}}
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"error": repr(exc)}}
+
+    def close(self) -> None:
+        self._client.disconnect()
+
+
 def new_cassandra(config, logger, metrics):
     hosts = config.get_or_default("CASSANDRA_HOSTS", "")
     if hosts in ("", "mock"):
@@ -195,9 +249,4 @@ def new_clickhouse(config, logger, metrics):
     host = config.get_or_default("CLICKHOUSE_HOST", "")
     if host in ("", "mock"):
         return MockClickhouse(logger, metrics)
-    try:
-        import clickhouse_driver  # noqa: F401
-    except ImportError as exc:
-        raise NoSQLError("CLICKHOUSE_HOST configured but clickhouse-driver "
-                         "is not installed") from exc
-    raise NoSQLError("clickhouse driver wiring requires clickhouse-driver")
+    return ClickhouseClient(config, logger, metrics)

@@ -1,0 +1,438 @@
+"""Execute the gated real-driver branches with sys.modules stubs.
+
+The image ships none of google-cloud-pubsub / pymongo / cassandra-driver /
+clickhouse-driver, so these datasource branches would otherwise be dead
+code in CI (VERDICT r2 #6). Each stub implements exactly the driver
+surface the wrapper consumes and records calls, mirroring how the
+reference tests its drivers against gomock seams rather than live
+clusters (SURVEY.md §4)."""
+
+import asyncio
+import sys
+import types
+
+import pytest
+
+from gofr_tpu.container import new_mock_container
+
+
+def _module(name, **attrs):
+    mod = types.ModuleType(name)
+    for key, value in attrs.items():
+        setattr(mod, key, value)
+    return mod
+
+
+# -- google cloud pub/sub -----------------------------------------------------
+
+class _FakeFuture:
+    def result(self, timeout=None):
+        return "msg-id-1"
+
+
+class _FakePublisher:
+    def __init__(self):
+        self.published = []
+        self.topics_created = []
+        self.topics_deleted = []
+
+    def topic_path(self, project, topic):
+        return f"projects/{project}/topics/{topic}"
+
+    def publish(self, path, payload, **attrs):
+        self.published.append((path, payload, attrs))
+        return _FakeFuture()
+
+    def create_topic(self, request):
+        self.topics_created.append(request["name"])
+
+    def delete_topic(self, request):
+        self.topics_deleted.append(request["topic"])
+
+    def list_topics(self, request):
+        return []
+
+
+class _FakeReceived:
+    def __init__(self, data):
+        self.data = data
+        self.acked = False
+
+    def ack(self):
+        self.acked = True
+
+
+class _FakePull:
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self):
+        self.cancelled = True
+
+
+class _FakeSubscriber:
+    def __init__(self):
+        self.callbacks = {}
+        self.subscriptions = []
+        self.pulls = []
+
+    def subscription_path(self, project, name):
+        return f"projects/{project}/subscriptions/{name}"
+
+    def create_subscription(self, request):
+        self.subscriptions.append(request["name"])
+
+    def subscribe(self, sub_path, callback):
+        self.callbacks[sub_path] = callback
+        pull = _FakePull()
+        self.pulls.append(pull)
+        return pull
+
+
+@pytest.fixture()
+def google_stub(monkeypatch):
+    publisher, subscriber = _FakePublisher(), _FakeSubscriber()
+    pubsub_v1 = _module("google.cloud.pubsub_v1",
+                        PublisherClient=lambda: publisher,
+                        SubscriberClient=lambda: subscriber)
+    cloud = _module("google.cloud", pubsub_v1=pubsub_v1)
+    google = _module("google", cloud=cloud)
+    monkeypatch.setitem(sys.modules, "google", google)
+    monkeypatch.setitem(sys.modules, "google.cloud", cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.pubsub_v1", pubsub_v1)
+    return publisher, subscriber
+
+
+def test_google_pubsub_real_branch(google_stub):
+    publisher, subscriber = google_stub
+    from gofr_tpu.datasource.pubsub.google import GoogleClient
+    container = new_mock_container({"GOOGLE_PROJECT_ID": "proj-1",
+                                    "GOOGLE_SUBSCRIPTION_NAME": "svc"})
+    client = GoogleClient(container.config, container.logger,
+                          container.metrics)
+
+    client.create_topic("orders")
+    assert publisher.topics_created == ["projects/proj-1/topics/orders"]
+
+    client.publish("orders", b"payload-1", key=b"k1")
+    path, payload, attrs = publisher.published[0]
+    assert path.endswith("/topics/orders") and payload == b"payload-1"
+    assert attrs["key"] == "k1"
+
+    async def roundtrip():
+        task = asyncio.ensure_future(client.subscribe("orders"))
+        await asyncio.sleep(0.05)   # _ensure_pull registered the callback
+        sub_path = "projects/proj-1/subscriptions/svc-orders"
+        received = _FakeReceived(b"payload-1")
+        subscriber.callbacks[sub_path](received)
+        message = await asyncio.wait_for(task, 10.0)
+        return message, received
+
+    message, received = asyncio.run(roundtrip())
+    assert message.topic == "orders" and message.value == b"payload-1"
+    message.commit()
+    assert received.acked
+    assert subscriber.subscriptions == [
+        "projects/proj-1/subscriptions/svc-orders"]
+
+    assert client.health_check()["status"] == "UP"
+    client.delete_topic("orders")
+    assert publisher.topics_deleted == ["projects/proj-1/topics/orders"]
+    client.close()
+    assert all(p.cancelled for p in subscriber.pulls)
+
+
+def test_google_pubsub_requires_project(google_stub):
+    from gofr_tpu.datasource.pubsub.google import (GoogleClient,
+                                                   GoogleClientError)
+    container = new_mock_container()
+    with pytest.raises(GoogleClientError, match="GOOGLE_PROJECT_ID"):
+        GoogleClient(container.config, container.logger, container.metrics)
+
+
+# -- pymongo ------------------------------------------------------------------
+
+class _FakeInsertOne:
+    def __init__(self, inserted_id):
+        self.inserted_id = inserted_id
+
+
+class _FakeInsertMany:
+    def __init__(self, ids):
+        self.inserted_ids = ids
+
+
+class _FakeUpdate:
+    def __init__(self, n):
+        self.modified_count = n
+
+
+class _FakeDelete:
+    def __init__(self, n):
+        self.deleted_count = n
+
+
+class _FakeCursor(list):
+    def limit(self, n):
+        return _FakeCursor(self[:n])
+
+
+class _FakeCollection:
+    def __init__(self):
+        self.docs = []
+        self._seq = 0
+
+    def insert_one(self, doc):
+        self._seq += 1
+        doc = dict(doc)
+        doc.setdefault("_id", self._seq)
+        self.docs.append(doc)
+        return _FakeInsertOne(doc["_id"])
+
+    def insert_many(self, docs):
+        return _FakeInsertMany([self.insert_one(d).inserted_id
+                                for d in docs])
+
+    @staticmethod
+    def _match(doc, filt):
+        return all(doc.get(k) == v for k, v in (filt or {}).items())
+
+    def find(self, filt):
+        return _FakeCursor(d for d in self.docs if self._match(d, filt))
+
+    def find_one(self, filt):
+        rows = self.find(filt)
+        return rows[0] if rows else None
+
+    def update_one(self, filt, update):
+        for doc in self.docs:
+            if self._match(doc, filt):
+                doc.update(update["$set"])
+                return _FakeUpdate(1)
+        return _FakeUpdate(0)
+
+    def update_many(self, filt, update):
+        n = 0
+        for doc in self.docs:
+            if self._match(doc, filt):
+                doc.update(update["$set"])
+                n += 1
+        return _FakeUpdate(n)
+
+    def delete_one(self, filt):
+        for i, doc in enumerate(self.docs):
+            if self._match(doc, filt):
+                del self.docs[i]
+                return _FakeDelete(1)
+        return _FakeDelete(0)
+
+    def delete_many(self, filt):
+        before = len(self.docs)
+        self.docs = [d for d in self.docs if not self._match(d, filt)]
+        return _FakeDelete(before - len(self.docs))
+
+    def count_documents(self, filt):
+        return len(self.find(filt))
+
+    def drop(self):
+        self.docs = []
+
+
+class _FakeDatabase(dict):
+    def __missing__(self, name):
+        self[name] = _FakeCollection()
+        return self[name]
+
+
+class _FakeAdmin:
+    def command(self, name):
+        return {"ok": 1}
+
+
+class _FakeMongoClient:
+    instances = []
+
+    def __init__(self, uri, **kwargs):
+        self.uri = uri
+        self.kwargs = kwargs
+        self.dbs = {}
+        self.admin = _FakeAdmin()
+        self.closed = False
+        _FakeMongoClient.instances.append(self)
+
+    def __getitem__(self, name):
+        return self.dbs.setdefault(name, _FakeDatabase())
+
+    def close(self):
+        self.closed = True
+
+
+def test_pymongo_real_branch(monkeypatch):
+    monkeypatch.setitem(sys.modules, "pymongo",
+                        _module("pymongo", MongoClient=_FakeMongoClient))
+    from gofr_tpu.datasource.mongo import new_mongo
+    container = new_mock_container({
+        "MONGO_URI": "mongodb://db:27017", "MONGO_DATABASE": "appdb"})
+    client = new_mongo(container.config, container.logger,
+                       container.metrics)
+    assert type(client).__name__ == "PyMongoClient"
+
+    uid = client.insert_one("users", {"name": "ada"})
+    client.insert_many("users", [{"name": "gus"}, {"name": "liz"}])
+    assert client.count_documents("users") == 3
+    assert client.find_one("users", {"name": "ada"})["_id"] == uid
+    assert len(client.find("users", limit=2)) == 2
+    assert client.update_by_id("users", uid, {"name": "ada2"}) == 1
+    assert client.update_many("users", {"name": "gus"},
+                              {"$set": {"name": "gus2"}}) == 1
+    assert client.delete_one("users", {"name": "liz"}) == 1
+    assert client.delete_many("users", {}) == 2
+    client.drop_collection("users")
+    assert client.health_check()["status"] == "UP"
+    client.close()
+    assert _FakeMongoClient.instances[-1].closed
+    assert _FakeMongoClient.instances[-1].kwargs[
+        "serverSelectionTimeoutMS"] == 5000
+
+
+# -- cassandra ----------------------------------------------------------------
+
+class _FakeCassRow:
+    def __init__(self, mapping):
+        self._mapping = dict(mapping)
+        for key, value in mapping.items():
+            setattr(self, key, value)
+
+    def _asdict(self):
+        return dict(self._mapping)
+
+
+class _FakeCassResult(list):
+    def one(self):
+        return self[0] if self else None
+
+
+class _FakeSession:
+    def __init__(self):
+        self.executed = []
+        self.rows = []
+
+    def execute(self, query, params=None):
+        self.executed.append((query, params))
+        return _FakeCassResult(_FakeCassRow(r) for r in self.rows)
+
+
+class _FakeCluster:
+    instances = []
+
+    def __init__(self, hosts, port=9042):
+        self.hosts = hosts
+        self.port = port
+        self.session = _FakeSession()
+        self.shut = False
+        _FakeCluster.instances.append(self)
+
+    def connect(self, keyspace=None):
+        self.keyspace = keyspace
+        return self.session
+
+    def shutdown(self):
+        self.shut = True
+
+
+def test_cassandra_real_branch(monkeypatch):
+    cluster_mod = _module("cassandra.cluster", Cluster=_FakeCluster)
+    monkeypatch.setitem(sys.modules, "cassandra",
+                        _module("cassandra", cluster=cluster_mod))
+    monkeypatch.setitem(sys.modules, "cassandra.cluster", cluster_mod)
+    from gofr_tpu.datasource.nosql import new_cassandra
+    container = new_mock_container({
+        "CASSANDRA_HOSTS": "n1,n2", "CASSANDRA_PORT": "9142",
+        "CASSANDRA_KEYSPACE": "ks"})
+    client = new_cassandra(container.config, container.logger,
+                           container.metrics)
+    cluster = _FakeCluster.instances[-1]
+    assert cluster.hosts == ["n1", "n2"] and cluster.port == 9142
+    assert cluster.keyspace == "ks"
+
+    session = cluster.session
+    session.rows = [{"id": 1, "name": "ada"}]
+    rows = client.query(None, "SELECT * FROM users WHERE id=%s", 1)
+    assert rows == [{"id": 1, "name": "ada"}]
+    client.exec("INSERT INTO users (id) VALUES (%s)", 2)
+    assert session.executed[-1][1] == (2,)
+
+    session.rows = [{"applied": True}]
+    assert client.exec_cas("INSERT ... IF NOT EXISTS") is True
+    session.rows = [{"applied": False}]
+    assert client.exec_cas("INSERT ... IF NOT EXISTS") is False
+
+    assert client.health_check()["status"] == "UP"
+    client.close()
+    assert cluster.shut
+
+
+# -- clickhouse ---------------------------------------------------------------
+
+class _FakeCHClient:
+    instances = []
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        self.executed = []
+        self.rows = []
+        self.columns = []
+        self.disconnected = False
+        _FakeCHClient.instances.append(self)
+
+    def execute(self, query, params=None, with_column_types=False,
+                settings=None):
+        self.executed.append((query, params, settings))
+        if with_column_types:
+            return list(self.rows), list(self.columns)
+        return list(self.rows)
+
+    def disconnect(self):
+        self.disconnected = True
+
+
+def test_clickhouse_real_branch(monkeypatch):
+    monkeypatch.setitem(
+        sys.modules, "clickhouse_driver",
+        _module("clickhouse_driver", Client=_FakeCHClient))
+    from gofr_tpu.datasource.nosql import new_clickhouse
+    container = new_mock_container({"CLICKHOUSE_HOST": "ch1",
+                                    "CLICKHOUSE_DB": "metrics"})
+    client = new_clickhouse(container.config, container.logger,
+                            container.metrics)
+    fake = _FakeCHClient.instances[-1]
+    assert fake.kwargs["host"] == "ch1"
+    assert fake.kwargs["database"] == "metrics"
+
+    client.exec("CREATE TABLE t (x Int32) ENGINE = Memory")
+    fake.rows = [(1, "a"), (2, "b")]
+    fake.columns = [("x", "Int32"), ("s", "String")]
+    rows = client.select(None, "SELECT * FROM t")
+    assert rows == [{"x": 1, "s": "a"}, {"x": 2, "s": "b"}]
+
+    client.async_insert("INSERT INTO t VALUES", (3, "c"))
+    query, params, settings = fake.executed[-1]
+    assert settings == {"async_insert": 1, "wait_for_async_insert": 0}
+
+    assert client.health_check()["status"] == "UP"
+    client.close()
+    assert fake.disconnected
+
+
+def test_missing_drivers_raise_clear_errors():
+    """Without the stubs the gated branches must fail with actionable
+    configuration errors, not ImportError tracebacks."""
+    from gofr_tpu.datasource.mongo import MongoError, new_mongo
+    from gofr_tpu.datasource.nosql import NoSQLError, new_clickhouse
+    container = new_mock_container({"MONGO_URI": "mongodb://x",
+                                    "CLICKHOUSE_HOST": "ch"})
+    with pytest.raises(MongoError, match="pymongo"):
+        new_mongo(container.config, container.logger, container.metrics)
+    with pytest.raises(NoSQLError, match="clickhouse-driver"):
+        new_clickhouse(container.config, container.logger,
+                       container.metrics)
