@@ -1,0 +1,39 @@
+"""CRUD scaffolding example — parity with reference
+examples/using-add-rest-handlers/main.go: one dataclass registers five
+REST routes (POST/GET-all/GET/PUT/DELETE /user) against the configured
+SQL datasource; the table is created by a migration at boot.
+"""
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_app
+from gofr_tpu.migration import Migration
+
+
+@dataclasses.dataclass
+class User:
+    id: int = 0
+    name: str = ""
+    age: int = 0
+    is_employed: bool = False
+
+
+def create_table(ds):
+    ds.sql.execute(
+        "CREATE TABLE IF NOT EXISTS user ("
+        "id INTEGER PRIMARY KEY, name TEXT, age INTEGER, "
+        "is_employed BOOLEAN)")
+
+
+def build_app():
+    app = new_app()
+    app.migrate({1: Migration(up=create_table)})
+    app.add_rest_handlers(User)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

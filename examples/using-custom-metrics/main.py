@@ -1,0 +1,61 @@
+"""Custom metrics example — parity with reference
+examples/using-custom-metrics/main.go: an ecommerce app registers its own
+counter / up-down counter / gauge / histogram and drives them from
+handlers; everything lands on the same Prometheus endpoint (:2121) as the
+framework catalog.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_app
+from gofr_tpu.http.errors import InvalidParam
+
+TRANSACTION_SUCCESS = "transaction_success"
+TRANSACTION_TIME = "transaction_time"
+TOTAL_CREDIT_DAY_SALES = "total_credit_day_sale"
+PRODUCT_STOCK = "product_stock"
+
+
+async def transaction(ctx):
+    start = time.perf_counter()
+    data = ctx.bind()
+    if "amount" not in data:
+        raise InvalidParam(["amount"])
+    # ... transaction logic ...
+    ctx.metrics.increment_counter(TRANSACTION_SUCCESS)
+    ctx.metrics.delta_updown_counter(TOTAL_CREDIT_DAY_SALES,
+                                     float(data["amount"]))
+    ctx.metrics.set_gauge(PRODUCT_STOCK, float(data.get("stock_left", 0)))
+    ctx.metrics.record_histogram(TRANSACTION_TIME,
+                                 time.perf_counter() - start)
+    return "transaction successful"
+
+
+async def sale_return(ctx):
+    data = ctx.bind()
+    ctx.metrics.delta_updown_counter(TOTAL_CREDIT_DAY_SALES,
+                                     -float(data.get("amount", 0)))
+    return "return successful"
+
+
+def build_app():
+    app = new_app()
+    metrics = app.container.metrics
+    metrics.new_counter(TRANSACTION_SUCCESS,
+                        "count of successful transactions")
+    metrics.new_updown_counter(TOTAL_CREDIT_DAY_SALES,
+                               "total credit sales in a day")
+    metrics.new_gauge(PRODUCT_STOCK, "products in stock")
+    metrics.new_histogram(TRANSACTION_TIME,
+                          "time taken by a transaction (s)",
+                          (0.005, 0.01, 0.015, 0.02, 0.025, 0.035))
+    app.post("/transaction", transaction)
+    app.post("/return", sale_return)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,39 @@
+"""File-bind example — parity with reference examples/using-file-bind:
+POST /upload takes a multipart form with a text field (``name``) and an
+uploaded file (``upload``); the handler binds both, inspects the file and
+reports its size (the reference unpacks a zip via its file abstraction —
+here gofr_tpu.file_utils handles zips with a zip-bomb guard).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_app
+from gofr_tpu.http.errors import InvalidParam
+from gofr_tpu.http.request import UploadedFile
+
+
+async def upload(ctx):
+    form = ctx.bind()
+    blob = form.get("upload")
+    if not isinstance(blob, UploadedFile):
+        raise InvalidParam(["upload"])
+    info = {"name": form.get("name", ""),
+            "filename": blob.filename,
+            "bytes": len(blob.content)}
+    if blob.filename.endswith(".zip"):
+        from gofr_tpu.file_utils import unzip_bytes
+        members = unzip_bytes(blob.content)
+        info["zip_members"] = sorted(members)
+    return info
+
+
+def build_app():
+    app = new_app()
+    app.post("/upload", upload)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,47 @@
+"""Outbound HTTP service example — parity with reference
+examples/using-http-service/main.go: two named downstream services (one
+with a circuit breaker + custom health endpoint, one with a health
+endpoint only); GET /fact proxies through the first.
+
+Run: ``FACTS_URL=http://localhost:9000 python main.py`` then
+``GET /fact``. Downstream health is aggregated into
+``/.well-known/health`` alongside datasources.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_app
+from gofr_tpu.service import CircuitBreakerConfig, HealthConfig
+
+
+def fact(ctx):
+    # plain def: the framework runs sync handlers on the worker pool, so
+    # the blocking outbound call never stalls the event loop
+    service = ctx.get_http_service("cat-facts")
+    response = service.get("/fact")
+    data = response.json()
+    if isinstance(data, dict) and "data" in data:
+        data = data["data"]   # unwrap a gofr-style envelope
+    ctx.logger.info("fetched fact of length %s", data.get("length"))
+    return {"fact": data.get("fact"), "length": data.get("length")}
+
+
+def build_app():
+    app = new_app()
+    base = os.environ.get("FACTS_URL", "https://catfact.ninja")
+    # circuit breaker: 4 consecutive failures open the breaker; a probe
+    # every second closes it again (main.go CircuitBreakerConfig analog)
+    app.add_http_service("cat-facts", base,
+                         CircuitBreakerConfig(threshold=4, interval=1.0),
+                         HealthConfig("breeds"))
+    # second service with a deliberately wrong health endpoint, to show
+    # DEGRADED aggregation (main.go "fact-checker")
+    app.add_http_service("fact-checker", base, HealthConfig("breed"))
+    app.get("/fact", fact)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,43 @@
+"""Publisher example — parity with reference examples/using-publisher:
+POST /publish-order and POST /publish-product publish the bound JSON body
+to their topics through the configured pub/sub backend
+(``PUBSUB_BACKEND`` = KAFKA | MQTT | GOOGLE | INMEM).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_app
+from gofr_tpu.http.errors import InvalidParam
+
+
+def _publish(ctx, topic, required_fields):
+    data = ctx.bind()
+    missing = [f for f in required_fields if f not in data]
+    if missing:
+        raise InvalidParam(missing)
+    ctx.publish(topic, json.dumps(data).encode())
+    return "Published"
+
+
+async def order(ctx):
+    """{"orderId": "...", "status": "..."} → topic order-logs."""
+    return _publish(ctx, "order-logs", ("orderId", "status"))
+
+
+async def product(ctx):
+    """{"productId": "...", "price": "..."} → topic products."""
+    return _publish(ctx, "products", ("productId", "price"))
+
+
+def build_app():
+    app = new_app()
+    app.post("/publish-order", order)
+    app.post("/publish-product", product)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

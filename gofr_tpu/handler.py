@@ -57,7 +57,9 @@ def wrap_handler(func: Handler, container, timeout: Optional[float] = None):
             if hasattr(exc, "status_code"):
                 result, error = None, exc
             else:
-                result, error = None, PanicRecovery(str(exc))
+                # generic body (reference ErrorPanicRecovery): the real
+                # exception is logged above, never leaked to the client
+                result, error = None, PanicRecovery()
         return _responder.respond(result, error, request.method)
 
     return wire_handler

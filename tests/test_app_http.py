@@ -3,6 +3,7 @@ examples/*/main_test.go style: start the app, fire real HTTP — SURVEY.md §4).
 
 import asyncio
 import json
+import time
 
 from gofr_tpu.http.errors import EntityNotFound
 
@@ -84,6 +85,9 @@ def test_panic_isolation():
             result = await http_request(port, "GET", "/boom")
             assert result.status == 500
             assert "message" in result.json()["error"]
+            # generic body (reference ErrorPanicRecovery): the exception
+            # text is logged, never leaked to the client
+            assert "kaboom" not in result.body.decode()
             # server still alive afterwards
             alive = await http_request(port, "GET", "/.well-known/alive")
             assert alive.status == 200
@@ -101,8 +105,11 @@ def test_request_timeout():
 
         app.get("/slow", slow)
         async with serving(app) as port:
+            t0 = time.perf_counter()
             result = await http_request(port, "GET", "/slow")
             assert result.status == 408
+            # the 408 arrives at the deadline, not after the handler's 5 s
+            assert time.perf_counter() - t0 < 2.0
     run(main())
 
 

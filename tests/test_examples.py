@@ -123,3 +123,168 @@ def test_migrations_example_boots():
     rows = module.app.container.sql.select("SELECT * FROM employee")
     assert rows[0]["name"] == "ada"
     assert module.app.container.redis.get("employee:seeded") == "true"
+
+
+def test_http_service_example_proxies_and_degrades():
+    """Reference using-http-service/main_test.go analog: run a real
+    upstream, proxy /fact through the named service; the bad-health
+    service degrades /.well-known/health."""
+
+    async def main():
+        upstream = _zero_ports(__import__("gofr_tpu").new_app())
+
+        def fact_handler(ctx):
+            return {"fact": "cats nap a lot", "length": 14}
+
+        def breeds(ctx):
+            return {"ok": True}
+
+        upstream.get("/fact", fact_handler)
+        upstream.get("/breeds", breeds)
+        await upstream.start()
+        try:
+            os.environ["FACTS_URL"] = \
+                f"http://127.0.0.1:{upstream.bound_http_port}"
+            mod = _load_example("using-http-service")
+            app = _zero_ports(mod.build_app())
+            async with serving(app) as port:
+                result = await http_request(port, "GET", "/fact")
+                data = result.json()["data"]
+                assert data["fact"] == "cats nap a lot"
+                assert data["length"] == 14
+                health = await http_request(port, "GET",
+                                            "/.well-known/health")
+                assert "cat-facts" in json.dumps(health.json())
+        finally:
+            await upstream.stop()
+    run(main())
+
+
+def test_publisher_example_publishes_to_topics():
+    module = _load_example("using-publisher", {"PUBSUB_BACKEND": "INMEM"})
+
+    async def main():
+        import asyncio
+        app = _zero_ports(module.build_app())
+        async with serving(app) as port:
+            result = await http_request(
+                port, "POST", "/publish-order",
+                body=json.dumps({"orderId": "o1",
+                                 "status": "pending"}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert result.json()["data"] == "Published"
+            message = await asyncio.wait_for(
+                app.container.pubsub.subscribe("order-logs"), 10.0)
+            assert json.loads(message.value)["orderId"] == "o1"
+            # missing fields → 400
+            bad = await http_request(
+                port, "POST", "/publish-product",
+                body=json.dumps({"productId": "p1"}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert bad.status == 400
+    run(main())
+
+
+def test_file_bind_example_uploads_multipart():
+    module = _load_example("using-file-bind")
+
+    async def main():
+        import io
+        import zipfile
+        buffer = io.BytesIO()
+        with zipfile.ZipFile(buffer, "w") as zf:
+            zf.writestr("a.txt", "alpha")
+            zf.writestr("b/b.txt", "beta")
+        blob = buffer.getvalue()
+        boundary = "bnd123"
+        body = (
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="name"\r\n\r\n'
+            "hello\r\n"
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="upload"; '
+            'filename="data.zip"\r\n'
+            "Content-Type: application/zip\r\n\r\n"
+        ).encode() + blob + f"\r\n--{boundary}--\r\n".encode()
+
+        app = _zero_ports(module.build_app())
+        async with serving(app) as port:
+            result = await http_request(
+                port, "POST", "/upload", body=body,
+                headers={"Content-Type":
+                         f"multipart/form-data; boundary={boundary}"})
+            data = result.json()["data"]
+            assert data["name"] == "hello"
+            assert data["filename"] == "data.zip"
+            assert data["bytes"] == len(blob)
+            assert data["zip_members"] == ["a.txt", "b/b.txt"]
+            # no file part → 400
+            bad = await http_request(
+                port, "POST", "/upload",
+                body=f"--{boundary}\r\nContent-Disposition: form-data; "
+                     f'name="name"\r\n\r\nx\r\n--{boundary}--\r\n'.encode(),
+                headers={"Content-Type":
+                         f"multipart/form-data; boundary={boundary}"})
+            assert bad.status == 400
+    run(main())
+
+
+def test_custom_metrics_example_lands_on_prometheus():
+    module = _load_example("using-custom-metrics")
+
+    async def main():
+        app = _zero_ports(module.build_app())
+        async with serving(app) as port:
+            for amount in (120, 80):
+                result = await http_request(
+                    port, "POST", "/transaction",
+                    body=json.dumps({"amount": amount,
+                                     "stock_left": 7}).encode(),
+                    headers={"Content-Type": "application/json"})
+                assert result.status in (200, 201)
+            await http_request(
+                port, "POST", "/return",
+                body=json.dumps({"amount": 50}).encode(),
+                headers={"Content-Type": "application/json"})
+            metrics_port = app._metrics_server.bound_port
+            exposition = (await http_request(
+                metrics_port, "GET", "/metrics")).body.decode()
+            assert "transaction_success 2" in exposition.replace(
+                "transaction_success{} 2", "transaction_success 2")
+            assert "total_credit_day_sale" in exposition
+            assert "product_stock 7" in exposition.replace(
+                "product_stock{} 7", "product_stock 7")
+            assert "transaction_time" in exposition
+    run(main())
+
+
+def test_add_rest_handlers_example_crud_roundtrip():
+    module = _load_example("using-add-rest-handlers",
+                           {"DB_DIALECT": "sqlite", "DB_NAME": ":memory:"})
+
+    async def main():
+        app = _zero_ports(module.build_app())
+        async with serving(app) as port:
+            created = await http_request(
+                port, "POST", "/user",
+                body=json.dumps({"id": 1, "name": "ada", "age": 36,
+                                 "is_employed": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert created.status in (200, 201)
+            everyone = await http_request(port, "GET", "/user")
+            assert [u["name"] for u in everyone.json()["data"]] == ["ada"]
+            one = await http_request(port, "GET", "/user/1")
+            assert one.json()["data"]["age"] == 36
+            updated = await http_request(
+                port, "PUT", "/user/1",
+                body=json.dumps({"id": 1, "name": "ada", "age": 37,
+                                 "is_employed": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert updated.status in (200, 201)
+            assert (await http_request(
+                port, "GET", "/user/1")).json()["data"]["age"] == 37
+            gone = await http_request(port, "DELETE", "/user/1")
+            assert gone.status in (200, 204)
+            missing = await http_request(port, "GET", "/user/1")
+            assert missing.status == 404
+    run(main())
