@@ -1,0 +1,222 @@
+"""Runtime-edge depth tests: handler timeout/panic isolation, context
+binding into dataclasses, in-memory broker semantics, dynamic-batcher
+error propagation and coalescing, executor oversized-batch splitting and
+dispatch/fetch parity — reference pkg/gofr/handler_test.go /
+grpc/http transport tests style."""
+
+import asyncio
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from tests.util import http_request, make_app, run, serving
+
+
+# -- handler semantics --------------------------------------------------------
+
+def test_sync_handler_runs_off_loop():
+    """A blocking sync handler must not freeze the event loop: a
+    concurrent async route stays responsive."""
+    async def main():
+        app = make_app()
+        release = asyncio.Event()
+
+        def blocking(ctx):
+            time.sleep(0.5)
+            return {"done": True}
+
+        async def ping(ctx):
+            return {"pong": True}
+
+        app.get("/block", blocking)
+        app.get("/ping", ping)
+        async with serving(app) as port:
+            block_task = asyncio.ensure_future(
+                http_request(port, "GET", "/block"))
+            await asyncio.sleep(0.05)   # blocking handler is running
+            t0 = time.perf_counter()
+            pong = await http_request(port, "GET", "/ping")
+            assert pong.status == 200
+            assert time.perf_counter() - t0 < 0.3
+            assert (await block_task).json()["data"]["done"] is True
+    run(main())
+
+
+# -- context binding ----------------------------------------------------------
+
+def test_bind_into_dataclass_and_query_params():
+    @dataclasses.dataclass
+    class Order:
+        order_id: str = ""
+        quantity: int = 0
+
+    async def main():
+        app = make_app()
+
+        async def create(ctx):
+            order = ctx.bind(Order)
+            assert isinstance(order, Order)
+            return {"order_id": order.order_id,
+                    "quantity": order.quantity,
+                    "tag": ctx.param("tag"),
+                    "tags": ctx.params("tag")}
+
+        app.post("/orders", create)
+        async with serving(app) as port:
+            result = await http_request(
+                port, "POST", "/orders?tag=a&tag=b",
+                body=json.dumps({"order_id": "o1", "quantity": 3}).encode(),
+                headers={"Content-Type": "application/json"})
+            data = result.json()["data"]
+            assert data == {"order_id": "o1", "quantity": 3,
+                            "tag": "a", "tags": ["a", "b"]}
+    run(main())
+
+
+def test_bind_form_urlencoded():
+    async def main():
+        app = make_app()
+        app.post("/form", lambda ctx: ctx.bind())
+        async with serving(app) as port:
+            result = await http_request(
+                port, "POST", "/form", body=b"name=ada&age=36",
+                headers={"Content-Type":
+                         "application/x-www-form-urlencoded"})
+            assert result.json()["data"] == {"name": "ada", "age": "36"}
+    run(main())
+
+
+# -- in-memory broker ---------------------------------------------------------
+
+def test_inmem_broker_fifo_and_commit(mock_container):
+    broker = mock_container.pubsub
+
+    async def main():
+        for i in range(3):
+            broker.publish("events", f"m{i}".encode())
+        got = [await asyncio.wait_for(broker.subscribe("events"), 5.0)
+               for _ in range(3)]
+        assert [m.value for m in got] == [b"m0", b"m1", b"m2"]
+        got[0].commit()
+        assert got[0].committed
+    run(main())
+
+
+def test_inmem_broker_topic_isolation(mock_container):
+    broker = mock_container.pubsub
+
+    async def main():
+        broker.publish("a", b"for-a")
+        broker.publish("b", b"for-b")
+        message = await asyncio.wait_for(broker.subscribe("b"), 5.0)
+        assert message.value == b"for-b"
+    run(main())
+
+
+# -- dynamic batcher ----------------------------------------------------------
+
+def _executor(mock_container, fn=None, buckets=(1, 2, 4, 8)):
+    from gofr_tpu.tpu import Executor
+    executor = Executor(mock_container.logger, mock_container.metrics)
+    executor.register("m", fn or (lambda p, x: x * 2.0), {},
+                      buckets=buckets)
+    return executor
+
+
+def test_batcher_coalesces_concurrent_requests(mock_container):
+    from gofr_tpu.tpu import DynamicBatcher
+    executor = _executor(mock_container)
+    batcher = DynamicBatcher(executor, max_batch=8, max_delay_ms=20.0,
+                             logger=mock_container.logger)
+
+    async def main():
+        outs = await asyncio.gather(*[
+            batcher.predict("m", np.full((2,), i, np.float32))
+            for i in range(6)])
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(out, [2.0 * i] * 2)
+        # 6 examples coalesced into few executes, not 6
+        count = mock_container.metrics.value("app_tpu_requests_total",
+                                             model="m")
+        assert count is not None and count <= 3
+    run(main())
+
+
+def test_batcher_propagates_model_failure(mock_container):
+    from gofr_tpu.tpu import DynamicBatcher
+
+    def exploding(p, x):
+        raise ValueError("bad batch")
+
+    executor = _executor(mock_container, fn=exploding)
+    batcher = DynamicBatcher(executor, max_batch=4, max_delay_ms=5.0,
+                             logger=mock_container.logger)
+
+    async def main():
+        with pytest.raises(Exception):
+            await batcher.predict("m", np.ones((2,), np.float32))
+    run(main())
+
+
+def test_batcher_full_batch_flushes_before_timer(mock_container):
+    from gofr_tpu.tpu import DynamicBatcher
+    executor = _executor(mock_container)
+    batcher = DynamicBatcher(executor, max_batch=4, max_delay_ms=10_000.0,
+                             logger=mock_container.logger)
+
+    async def main():
+        t0 = time.perf_counter()
+        outs = await asyncio.wait_for(asyncio.gather(*[
+            batcher.predict("m", np.ones((1,), np.float32))
+            for _ in range(4)]), 5.0)
+        # max_batch reached → flush NOW, not after the 10 s deadline
+        assert time.perf_counter() - t0 < 2.0
+        assert len(outs) == 4
+    run(main())
+
+
+# -- executor -----------------------------------------------------------------
+
+def test_executor_splits_oversized_batches(mock_container):
+    executor = _executor(mock_container, buckets=(1, 2, 4))
+    batch = np.arange(11, dtype=np.float32)
+    out = executor.predict("m", batch)
+    np.testing.assert_allclose(out, batch * 2.0)
+
+
+def test_executor_dispatch_fetch_matches_predict(mock_container):
+    executor = _executor(mock_container)
+    batch = np.arange(3, dtype=np.float32)
+    direct = executor.predict("m", batch)
+    handle = executor.dispatch("m", batch)
+    fetched = executor.fetch(handle)
+    np.testing.assert_allclose(fetched, direct)
+    assert executor.is_warm("m", 3)
+    assert not executor.is_warm("missing", 1)
+    with pytest.raises(ValueError):
+        executor.dispatch("m", np.ones((99,), np.float32))
+    with pytest.raises(KeyError):
+        executor.dispatch("missing", batch)
+
+
+def test_executor_unknown_model_raises(mock_container):
+    executor = _executor(mock_container)
+    with pytest.raises(KeyError, match="not registered"):
+        executor.predict("nope", np.ones((1,), np.float32))
+
+
+def test_executor_pads_and_slices(mock_container):
+    recorded = []
+
+    def spy(p, x):
+        recorded.append(x.shape[0])
+        return x + 1.0
+
+    executor = _executor(mock_container, fn=spy, buckets=(4, 8))
+    out = executor.predict("m", np.zeros((3,), np.float32))
+    assert out.shape == (3,)          # padding sliced off
+    assert recorded[-1] == 4          # padded up to the 4-bucket
