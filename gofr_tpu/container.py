@@ -191,7 +191,9 @@ def new_mock_container(config: Optional[Dict[str, str]] = None) -> Container:
     from gofr_tpu.datasource.redisx import InMemoryRedis
     from gofr_tpu.datasource.sql import new_sql
     container.pubsub = InMemoryBroker(container.logger, container.metrics)
-    container.file = LocalFileSystem(container.logger)
+    # unsandboxed: tests hand the fixture absolute tmp paths; production
+    # Container.create keeps the sandboxed default
+    container.file = LocalFileSystem(container.logger, sandbox=False)
     container.redis = InMemoryRedis(container.logger, container.metrics)
     container.sql = new_sql(MapConfig({"DB_DIALECT": "sqlite",
                                        "DB_NAME": ":memory:"}),

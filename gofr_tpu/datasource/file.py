@@ -18,12 +18,31 @@ from gofr_tpu.datasource import UP, health
 
 
 class LocalFileSystem:
-    def __init__(self, logger=None, root: str = "."):
+    """Local filesystem datasource (datasource/file.go contract).
+
+    ``sandbox=True`` (default) confines every operation — including
+    ``chdir`` and absolute paths — under the constructed root, so request
+    data forwarded into fs calls cannot traverse out (``../`` or
+    ``/etc/...`` raise PermissionError). Construct with ``sandbox=False``
+    for trusted tooling that genuinely needs the whole host filesystem
+    (the reference's Go file datasource mirrors os with no confinement).
+    """
+
+    def __init__(self, logger=None, root: str = ".", sandbox: bool = True):
         self.logger = logger
-        self.root = root
+        self.root = os.path.abspath(root)
+        self.sandbox = sandbox
+        self._sandbox_root = self.root
 
     def _full(self, name: str) -> str:
-        return name if os.path.isabs(name) else os.path.join(self.root, name)
+        base = name if os.path.isabs(name) else os.path.join(self.root, name)
+        full = os.path.abspath(base)
+        if self.sandbox:
+            root = self._sandbox_root
+            if full != root and not full.startswith(root + os.sep):
+                raise PermissionError(
+                    f"path escapes filesystem root {root!r}: {name!r}")
+        return full
 
     # -- FileSystem contract (datasource/file.go:10-63) ---------------------
     def create(self, name: str, content: bytes = b"") -> None:

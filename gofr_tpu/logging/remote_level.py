@@ -30,8 +30,12 @@ def _extract_level(doc) -> str:
 
 def start_remote_level_poller(logger: Logger, url: str,
                               interval: float = 15.0) -> threading.Thread:
+    """Returns the poller thread; call ``thread.stop()`` to end the loop
+    (tests / graceful shutdown — in a server it runs for the process
+    lifetime as a daemon, like the reference's goroutine)."""
+    stop = threading.Event()
+
     def poll_loop() -> None:
-        import time
         while True:
             try:
                 with urllib.request.urlopen(url, timeout=5) as resp:
@@ -45,9 +49,11 @@ def start_remote_level_poller(logger: Logger, url: str,
                         logger.change_level(new_level)
             except Exception:
                 pass
-            time.sleep(interval)
+            if stop.wait(interval):
+                return
 
     thread = threading.Thread(target=poll_loop, name="remote-log-level",
                               daemon=True)
+    thread.stop = stop.set  # type: ignore[attr-defined]
     thread.start()
     return thread

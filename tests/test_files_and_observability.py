@@ -1,0 +1,276 @@
+"""Depth tests: file datasource + row readers, SQL query builder
+dialects, zipkin trace exporter wire format, remote log-level poller,
+websocket server-initiated push, and cron job scheduling/isolation —
+reference pkg/gofr/datasource/file / trace / logging test coverage."""
+
+import asyncio
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from tests.util import http_request, make_app, run, serving
+
+
+# -- file datasource ----------------------------------------------------------
+
+def test_filesystem_crud_and_stat(tmp_path):
+    from gofr_tpu.datasource.file import LocalFileSystem
+    fs = LocalFileSystem(root=str(tmp_path))
+    fs.create("a.txt", b"hello")
+    assert fs.read("a.txt") == b"hello"
+    fs.append("a.txt", b" world")
+    assert fs.read("a.txt") == b"hello world"
+    fs.mkdir("sub")
+    fs.write("sub/b.txt", b"nested")
+    assert sorted(fs.list(".")) == ["a.txt", "sub"]
+    info = fs.stat("a.txt")
+    assert info["size"] == 11 and not info["is_dir"]
+    assert fs.stat("sub")["is_dir"]
+    fs.rename("a.txt", "c.txt")
+    assert fs.read("c.txt") == b"hello world"
+    fs.remove("c.txt")
+    fs.remove_all("sub")
+    assert fs.list(".") == []
+    assert fs.health_check()["status"] == "UP"
+
+
+def test_filesystem_sandbox_blocks_traversal(tmp_path):
+    from gofr_tpu.datasource.file import LocalFileSystem
+    (tmp_path / "inner").mkdir()
+    fs = LocalFileSystem(root=str(tmp_path / "inner"))
+    with pytest.raises(PermissionError):
+        fs.read("../" * 10 + "etc/passwd")
+    with pytest.raises(PermissionError):
+        fs.read("/etc/passwd")
+    with pytest.raises(PermissionError):
+        fs.chdir("..")                       # can't escape via chdir
+    fs.write("ok.txt", b"x")                 # normal ops unaffected
+    assert fs.read("ok.txt") == b"x"
+    # chdir stays confined to the ORIGINAL root, not the moved cwd
+    (tmp_path / "ok2.txt").write_bytes(b"y")
+    fs2 = LocalFileSystem(root=str(tmp_path))
+    fs2.chdir("inner")
+    assert fs2.read("ok.txt") == b"x"
+    assert fs2.read("../ok2.txt") == b"y"    # up to original root: fine
+    with pytest.raises(PermissionError):
+        fs2.read("../../outside")
+    # opt-out for trusted tooling (reference semantics: mirrors os)
+    unsandboxed = LocalFileSystem(root=str(tmp_path), sandbox=False)
+    assert unsandboxed.read("/etc/hostname") is not None
+
+
+def test_row_readers(tmp_path):
+    from gofr_tpu.datasource.file import LocalFileSystem
+    fs = LocalFileSystem(root=str(tmp_path))
+
+    fs.write("rows.json", json.dumps(
+        [{"id": 1, "name": "ada"}, {"id": 2, "name": "gus"}]).encode())
+    rows = list(fs.read_all("rows.json"))
+    assert rows == [{"id": 1, "name": "ada"}, {"id": 2, "name": "gus"}]
+
+    fs.write("one.json", json.dumps({"id": 3}).encode())
+    assert list(fs.read_all("one.json")) == [{"id": 3}]
+
+    fs.write("rows.csv", b"id,name\n1,ada\n2,gus\n")
+    rows = list(fs.read_all("rows.csv"))
+    assert rows == [{"id": "1", "name": "ada"},
+                    {"id": "2", "name": "gus"}]
+
+    fs.write("notes.txt", b"line one\nline two")
+    assert list(fs.read_all("notes.txt")) == ["line one", "line two"]
+
+
+# -- SQL query builder --------------------------------------------------------
+
+def test_query_builder_dialect_placeholders():
+    from gofr_tpu.datasource.sql.query_builder import (
+        delete_by_query, insert_query, select_all_query, select_by_query,
+        update_by_query)
+    sqlite_insert = insert_query("sqlite", "user", ["id", "name"])
+    assert "?" in sqlite_insert and "%s" not in sqlite_insert
+    pg_insert = insert_query("postgres", "user", ["id", "name"])
+    assert "%s" in pg_insert and "?" not in pg_insert
+    assert select_all_query("sqlite", "user") == "SELECT * FROM user"
+    assert "WHERE id" in select_by_query("sqlite", "user", "id")
+    update = update_by_query("mysql", "user", ["name", "age"], "id")
+    assert "name" in update and "WHERE id" in update and "%s" in update
+    assert "DELETE FROM user" in delete_by_query("sqlite", "user", "id")
+
+
+# -- zipkin exporter ----------------------------------------------------------
+
+class _SpanSink(BaseHTTPRequestHandler):
+    received = []
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        _SpanSink.received.append(json.loads(self.rfile.read(length)))
+        self.send_response(202)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, *args):
+        pass
+
+
+def test_zipkin_exporter_wire_format():
+    from gofr_tpu.config import MapConfig
+    from gofr_tpu.trace.tracer import new_tracer
+    _SpanSink.received = []
+    server = HTTPServer(("127.0.0.1", 0), _SpanSink)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        tracer = new_tracer(MapConfig({
+            "APP_NAME": "svc-a",
+            "TRACE_EXPORTER": "zipkin",
+            "TRACER_URL":
+                f"http://127.0.0.1:{server.server_port}/api/v2/spans"}))
+        with tracer.start_span("parent") as parent:
+            parent.set_attribute("uri", "/x")
+            with tracer.start_span("child"):
+                pass
+        tracer.shutdown()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not _SpanSink.received:
+            time.sleep(0.02)
+        assert _SpanSink.received, "no spans posted to the zipkin sink"
+        spans = [s for batch in _SpanSink.received for s in batch]
+        names = {s["name"] for s in spans}
+        assert {"parent", "child"} <= names
+        by_name = {s["name"]: s for s in spans}
+        # zipkin v2 contract: shared traceId, child carries parentId
+        assert by_name["child"]["traceId"] == by_name["parent"]["traceId"]
+        assert by_name["child"]["parentId"] == by_name["parent"]["id"]
+        assert by_name["parent"]["localEndpoint"]["serviceName"] == "svc-a"
+        assert by_name["parent"]["tags"]["uri"] == "/x"
+    finally:
+        server.shutdown()
+
+
+# -- remote log level poller --------------------------------------------------
+
+def test_remote_log_level_poller():
+    from gofr_tpu.logging import Level, new_silent_logger
+    from gofr_tpu.logging.remote_level import start_remote_level_poller
+
+    class _LevelServer(BaseHTTPRequestHandler):
+        level = "DEBUG"
+
+        def do_GET(self):
+            # reference remotelogger response shape
+            body = json.dumps(
+                {"data": [{"serviceLevel":
+                           {"logLevel": _LevelServer.level}}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), _LevelServer)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    logger = new_silent_logger()
+    poller = None
+    try:
+        poller = start_remote_level_poller(
+            logger, f"http://127.0.0.1:{server.server_port}/configs",
+            interval=0.05)
+        deadline = time.time() + 5.0
+        while time.time() < deadline and logger.level != Level.DEBUG:
+            time.sleep(0.02)
+        assert logger.level == Level.DEBUG
+        _LevelServer.level = "ERROR"
+        deadline = time.time() + 5.0
+        while time.time() < deadline and logger.level != Level.ERROR:
+            time.sleep(0.02)
+        assert logger.level == Level.ERROR
+    finally:
+        if poller is not None:
+            poller.stop()       # don't leak a 20 Hz thread into the run
+            poller.join(timeout=2.0)
+        server.shutdown()
+
+
+# -- websocket server push ----------------------------------------------------
+
+def test_websocket_server_initiated_messages():
+    """Server can push multiple messages before the client says anything
+    (reference websocket.go WriteMessage surface)."""
+    from gofr_tpu.websocket.frames import decode_frame
+
+    async def main():
+        app = make_app()
+
+        async def feed(ctx):
+            for i in range(3):
+                await ctx.write_message(f"tick {i}")
+            await ctx.read_message()     # wait for the client ack
+
+        app.websocket("/feed", feed)
+        async with serving(app) as port:
+            import base64
+            import os as _os
+            key = base64.b64encode(_os.urandom(16)).decode()
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write((
+                f"GET /feed HTTP/1.1\r\nHost: x\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert b"101" in head.split(b"\r\n")[0]
+            got = []
+            buffer = b""
+            while len(got) < 3:
+                chunk = await asyncio.wait_for(reader.read(256), 10.0)
+                assert chunk, "server closed before all pushes arrived"
+                buffer += chunk
+                while True:
+                    frame = decode_frame(buffer)
+                    if frame is None:
+                        break
+                    _opcode, _fin, payload, consumed = frame
+                    got.append(payload.decode())
+                    buffer = buffer[consumed:]
+            assert got == ["tick 0", "tick 1", "tick 2"]
+            writer.close()
+    run(main())
+
+
+# -- cron scheduling ----------------------------------------------------------
+
+def test_cron_job_exception_isolated_and_next_runs():
+    """A throwing job must not kill the crontab; later jobs still fire
+    (drive _run_job directly — deterministic, no minute-long sleeps)."""
+    from gofr_tpu.cron import Crontab
+    container = new_mock_container()
+    crontab = Crontab(container)
+    calls = []
+
+    def bad(ctx):
+        calls.append("bad")
+        raise RuntimeError("job exploded")
+
+    def good(ctx):
+        calls.append("good")
+
+    crontab.add_job("* * * * *", "bad-job", bad)
+    crontab.add_job("* * * * *", "good-job", good)
+    when = time.localtime()
+    assert all(job.due(when) for job in crontab.jobs)
+
+    async def main():
+        for job in crontab.jobs:
+            await crontab._run_job(job)    # bad job must not raise out
+        for job in crontab.jobs:
+            await crontab._run_job(job)
+    run(main())
+    assert calls.count("bad") == 2 and calls.count("good") == 2

@@ -285,6 +285,49 @@ def test_loop_failure_fails_futures_and_recovers(setup):
     asyncio.run(main())
 
 
+def test_tick_failure_resets_device_state_and_recovers(setup):
+    """A failure AFTER decode dispatch (donated cache consumed) must not
+    poison the engine: outstanding callers fail, device state is rebuilt,
+    and the next request succeeds with correct tokens (code-review r3
+    finding on _fail_outstanding)."""
+    cfg, params = setup
+    import numpy as np
+
+    async def main():
+        engine = _make_engine(cfg, params)
+        real = engine._decode_fn
+        boom = {"armed": True}
+
+        def exploding(k):
+            fn = real(k)
+
+            def wrapped(*args):
+                out = fn(*args)   # consumes the donated cache for real
+                if boom["armed"]:
+                    raise RuntimeError("injected post-dispatch failure")
+                return out
+            return wrapped
+
+        engine._decode_fn = exploding
+        await engine.start()
+        try:
+            with pytest.raises(RuntimeError, match="post-dispatch"):
+                await asyncio.wait_for(
+                    engine.generate([1, 2, 3], max_new_tokens=4), 60.0)
+            boom["armed"] = False
+            # device state was rebuilt — a fresh request must produce the
+            # same tokens as a clean engine
+            out = await asyncio.wait_for(
+                engine.generate([1, 2, 3], max_new_tokens=4), 60.0)
+            ref = llama.generate(params, cfg,
+                                 np.asarray([[1, 2, 3]], np.int32), 4)
+            assert out == [int(t) for t in np.asarray(ref)[0]]
+            assert engine.stats()["free_slots"] == engine.max_slots
+        finally:
+            await engine.stop()
+    asyncio.run(main())
+
+
 def test_exhausted_slot_does_not_stall_tick(setup):
     """ADVICE r2 low: one budget-exhausted slot (remaining covered by
     in-flight tokens) must not skip the tick for everyone — other active
