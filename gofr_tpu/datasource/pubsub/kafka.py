@@ -129,37 +129,66 @@ class _Broker:
     """One TCP connection + request/response correlation."""
 
     def __init__(self, host: str, port: int, client_id: str):
-        self.sock = socket.create_connection((host, port), timeout=10.0)
+        self.host = host
+        self.port = port
         self.client_id = client_id
         self.correlation = 0
         self.lock = threading.Lock()
+        self.sock = None
+        self.closed = False
+        self._connect()
+
+    def _connect(self) -> None:
+        if self.closed:
+            raise KafkaError("broker handle is closed")
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.sock = socket.create_connection((self.host, self.port),
+                                             timeout=10.0)
 
     def call(self, api_key: int, api_version: int, body: bytes) -> _Reader:
+        # One reconnect-and-reissue on transport failure (dead socket —
+        # broker restart), the same treatment as the Redis wire client.
+        # Standard Kafka at-least-once semantics: a retried Produce whose
+        # first attempt partially landed may duplicate, never lose.
         with self.lock:
-            self.correlation += 1
-            header = (struct.pack(">hhi", api_key, api_version,
-                                  self.correlation)
-                      + _string(self.client_id))
-            payload = header + body
-            self.sock.sendall(struct.pack(">i", len(payload)) + payload)
-            size = struct.unpack(">i", self._read(4))[0]
-            response = self._read(size)
+            try:
+                response = self._exchange(api_key, api_version, body)
+            except OSError:
+                self._connect()     # refuses after close(): no leaks
+                response = self._exchange(api_key, api_version, body)
+            expected = self.correlation
         reader = _Reader(response)
         correlation = reader.int32()
-        if correlation != self.correlation:
+        if correlation != expected:
             raise KafkaError("correlation id mismatch")
         return reader
+
+    def _exchange(self, api_key: int, api_version: int,
+                  body: bytes) -> bytes:
+        self.correlation += 1
+        header = (struct.pack(">hhi", api_key, api_version,
+                              self.correlation)
+                  + _string(self.client_id))
+        payload = header + body
+        self.sock.sendall(struct.pack(">i", len(payload)) + payload)
+        size = struct.unpack(">i", self._read(4))[0]
+        return self._read(size)
 
     def _read(self, n: int) -> bytes:
         data = b""
         while len(data) < n:
             chunk = self.sock.recv(n - len(data))
             if not chunk:
-                raise KafkaError("broker connection closed")
+                raise ConnectionError("broker connection closed")
             data += chunk
         return data
 
     def close(self):
+        self.closed = True
         try:
             self.sock.close()
         except OSError:
@@ -309,33 +338,46 @@ class KafkaClient(PubSub):
 
     # -- fetch loop (per-topic reader, kafka.go:181-186) --------------------
     def _poll_topic(self, topic: str) -> None:
+        """Per-topic fetch loop. Survives broker outages: an errored pass
+        (fetch/metadata failure beyond call()'s one immediate reconnect)
+        backs off and retries from the committed offset instead of dying —
+        otherwise the first multi-second restart would permanently kill
+        the subscription while publish happily recovers."""
         q = self._queues[topic]
-        offsets: Dict[int, int] = {}
-        try:
-            partitions = self._refresh_metadata(topic)
-            for partition in partitions:
-                committed = self._committed_offset(topic, partition)
-                offsets[partition] = committed or self._earliest_offset(
-                    topic, partition)
-            while not self._closed:
-                got_any = False
+        backoff = 0.1
+        while not self._closed:
+            try:
+                offsets: Dict[int, int] = {}
+                partitions = self._refresh_metadata(topic)
                 for partition in partitions:
-                    for offset, key, value in self._fetch(
-                            topic, partition, offsets[partition]):
-                        offsets[partition] = offset + 1
-                        committer = self._make_committer(topic, partition,
-                                                         offset + 1)
-                        q.put(Message(topic, value, key,
-                                      metadata={"partition": partition,
-                                                "offset": offset},
-                                      committer=committer))
-                        got_any = True
-                if not got_any:
-                    time.sleep(self.fetch_max_wait_ms / 1000.0)
-        except Exception as exc:
-            if not self._closed:
-                self.logger.error("kafka poller %s died: %r", topic, exc)
-            q.put(None)
+                    committed = self._committed_offset(topic, partition)
+                    offsets[partition] = committed or self._earliest_offset(
+                        topic, partition)
+                while not self._closed:
+                    got_any = False
+                    for partition in partitions:
+                        for offset, key, value in self._fetch(
+                                topic, partition, offsets[partition]):
+                            offsets[partition] = offset + 1
+                            committer = self._make_committer(
+                                topic, partition, offset + 1)
+                            q.put(Message(topic, value, key,
+                                          metadata={"partition": partition,
+                                                    "offset": offset},
+                                          committer=committer))
+                            got_any = True
+                    backoff = 0.1   # a clean pass resets the backoff
+                    if not got_any:
+                        time.sleep(self.fetch_max_wait_ms / 1000.0)
+            except Exception as exc:
+                if self._closed:
+                    break
+                self.logger.error(
+                    "kafka poller %s errored (retrying in %.1fs): %r",
+                    topic, backoff, exc)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+        q.put(None)
 
     def _make_committer(self, topic, partition, next_offset):
         return lambda: self._commit_offset(topic, partition, next_offset)

@@ -26,6 +26,7 @@ class FakeMQTTBroker:
         self.server.bind(("127.0.0.1", 0))
         self.server.listen(8)
         self.port = self.server.getsockname()[1]
+        self.conns = []
         self.subscribers = []
         self.lock = threading.Lock()
         self.running = True
@@ -96,6 +97,11 @@ class FakeMQTTBroker:
     def stop(self):
         self.running = False
         self.server.close()
+        for conn in self.conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 def test_mqtt_roundtrip():
@@ -139,15 +145,18 @@ class FakeKafkaBroker:
     Fetch v2 / ListOffsets v1 / OffsetFetch v1 / OffsetCommit v2 /
     CreateTopics v0 / DeleteTopics v0."""
 
-    def __init__(self):
+    def __init__(self, port=0):
         self.server = socket.socket()
         self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.server.bind(("127.0.0.1", 0))
+        if port:   # restart-on-same-port tests only: never on ephemeral
+            self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self.server.bind(("127.0.0.1", port))
         self.server.listen(8)
         self.port = self.server.getsockname()[1]
         self.logs = {}      # (topic, partition) -> list[(key, value)]
         self.offsets = {}   # (group, topic, partition) -> offset
         self.running = True
+        self.conns = []
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
     def _accept_loop(self):
@@ -156,6 +165,7 @@ class FakeKafkaBroker:
                 conn, _ = self.server.accept()
             except OSError:
                 return
+            self.conns.append(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -284,6 +294,11 @@ class FakeKafkaBroker:
     def stop(self):
         self.running = False
         self.server.close()
+        for conn in self.conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 @pytest.fixture()
