@@ -39,6 +39,11 @@ class KafkaError(Exception):
     pass
 
 
+class KafkaOffsetOutOfRange(KafkaError):
+    """Fetch error 1: committed offset expired (retention) or invalid —
+    the consumer must reset to the earliest available offset."""
+
+
 # -- primitive codecs --------------------------------------------------------
 
 def _string(s: Optional[str]) -> bytes:
@@ -146,8 +151,12 @@ class _Broker:
                 self.sock.close()
             except OSError:
                 pass
-        self.sock = socket.create_connection((self.host, self.port),
-                                             timeout=10.0)
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=10.0)
+        self.sock = sock
+        if self.closed:   # close() raced the reconnect: don't leak it
+            sock.close()
+            raise KafkaError("broker handle is closed")
 
     def call(self, api_key: int, api_version: int, body: bytes) -> _Reader:
         # One reconnect-and-reissue on transport failure (dead socket —
@@ -345,19 +354,33 @@ class KafkaClient(PubSub):
         the subscription while publish happily recovers."""
         q = self._queues[topic]
         backoff = 0.1
+        metadata_refresh_s = 30.0
         while not self._closed:
             try:
                 offsets: Dict[int, int] = {}
                 partitions = self._refresh_metadata(topic)
+                if not partitions:
+                    # topic doesn't exist yet (or metadata stale): retry
+                    # via the backoff path instead of idling forever
+                    raise KafkaError(f"no partitions for topic {topic!r}")
                 for partition in partitions:
                     committed = self._committed_offset(topic, partition)
                     offsets[partition] = committed or self._earliest_offset(
                         topic, partition)
+                refresh_at = time.monotonic() + metadata_refresh_s
                 while not self._closed:
                     got_any = False
                     for partition in partitions:
-                        for offset, key, value in self._fetch(
-                                topic, partition, offsets[partition]):
+                        try:
+                            batch = self._fetch(topic, partition,
+                                                offsets[partition])
+                        except KafkaOffsetOutOfRange:
+                            # retention expired past the committed offset:
+                            # reset to earliest (auto.offset.reset analog)
+                            offsets[partition] = self._earliest_offset(
+                                topic, partition)
+                            continue
+                        for offset, key, value in batch:
                             offsets[partition] = offset + 1
                             committer = self._make_committer(
                                 topic, partition, offset + 1)
@@ -367,6 +390,16 @@ class KafkaClient(PubSub):
                                           committer=committer))
                             got_any = True
                     backoff = 0.1   # a clean pass resets the backoff
+                    if time.monotonic() >= refresh_at:
+                        # periodically re-learn partitions (growth after
+                        # subscribe) without waiting for an error
+                        new = self._refresh_metadata(topic)
+                        for partition in new:
+                            if partition not in offsets:
+                                offsets[partition] = self._earliest_offset(
+                                    topic, partition)
+                        partitions = new or partitions
+                        refresh_at = time.monotonic() + metadata_refresh_s
                     if not got_any:
                         time.sleep(self.fetch_max_wait_ms / 1000.0)
             except Exception as exc:
@@ -398,6 +431,10 @@ class KafkaClient(PubSub):
                 error = reader.int16()
                 reader.int64()                        # high watermark
                 message_set = reader.raw_bytes() or b""
+                if error == 1:
+                    raise KafkaOffsetOutOfRange(
+                        f"offset {offset} out of range for "
+                        f"{topic}/{partition}")
                 if error:
                     raise KafkaError(f"fetch error code {error}")
                 out.extend(decode_message_set(message_set, offset))

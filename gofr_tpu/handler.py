@@ -38,8 +38,10 @@ def wrap_handler(func: Handler, container, timeout: Optional[float] = None):
             if is_async:
                 coro: Any = func(ctx)
             else:
-                loop = asyncio.get_running_loop()
-                coro = loop.run_in_executor(None, func, ctx)
+                # to_thread propagates contextvars into the worker thread
+                # (plain run_in_executor does NOT), so outbound service
+                # calls from sync handlers continue the inbound trace
+                coro = asyncio.to_thread(func, ctx)
             if timeout is not None and timeout > 0:
                 result = await asyncio.wait_for(coro, timeout)
             else:

@@ -1,0 +1,164 @@
+"""W3C trace-context continuity across the full hop chain: inbound HTTP
+→ handler → outbound service → upstream, plus correlation-id echo and
+Prometheus exposition semantics over the live metrics server."""
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from tests.util import http_request, make_app, run, serving
+
+
+class _RecordingUpstream(BaseHTTPRequestHandler):
+    seen = []
+
+    def do_GET(self):
+        _RecordingUpstream.seen.append(dict(self.headers))
+        body = b'{"ok": true}'
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def upstream():
+    server = HTTPServer(("127.0.0.1", 0), _RecordingUpstream)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    _RecordingUpstream.seen = []
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_traceparent_flows_inbound_to_outbound(upstream):
+    """The trace id minted (or adopted) for the inbound request must ride
+    the outbound call's traceparent header — one trace across services."""
+    async def main():
+        app = make_app()
+        from gofr_tpu.service import new_http_service
+        app.container.add_http_service("billing", new_http_service(
+            upstream, app.logger, app.container.metrics,
+            app.container.tracer))
+
+        def invoice(ctx):
+            response = ctx.get_http_service("billing").get("/charge")
+            return {"upstream": response.json()}
+
+        app.get("/invoice", invoice)
+        async with serving(app) as port:
+            incoming = ("00-11653cc56089d6abf294764e9e47dd34-"
+                        "b7ad6b7169203331-01")
+            result = await http_request(
+                port, "GET", "/invoice",
+                headers={"traceparent": incoming})
+            assert result.status == 200
+        seen = _RecordingUpstream.seen[-1]
+        outbound = {k.lower(): v for k, v in seen.items()}["traceparent"]
+        # same trace id, new span id (the handler's span)
+        assert outbound.split("-")[1] == "11653cc56089d6abf294764e9e47dd34"
+        assert outbound.split("-")[2] != "b7ad6b7169203331"
+    run(main())
+
+
+def test_correlation_id_echoed_and_stable():
+    async def main():
+        app = make_app()
+        app.get("/ping", lambda ctx: {"pong": True})
+        async with serving(app) as port:
+            first = await http_request(port, "GET", "/ping")
+            assert first.headers["x-correlation-id"]
+            incoming = ("00-aaaabbbbccccddddaaaabbbbccccdddd-"
+                        "1234123412341234-01")
+            second = await http_request(
+                port, "GET", "/ping", headers={"traceparent": incoming})
+            # adopted trace id becomes the correlation id
+            assert second.headers["x-correlation-id"] == \
+                "aaaabbbbccccddddaaaabbbbccccdddd"
+    run(main())
+
+
+def test_exposition_histogram_cumulates_and_counts():
+    """Prometheus text rules: histogram buckets are cumulative `le`
+    series ending at +Inf == _count, and counters carry labels."""
+    async def main():
+        app = make_app()
+        app.get("/work", lambda ctx: {"ok": True})
+        async with serving(app) as port:
+            for _ in range(3):
+                await http_request(port, "GET", "/work")
+            mport = app._metrics_server.bound_port
+            text = (await http_request(mport, "GET", "/metrics")
+                    ).body.decode()
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("app_http_response")]
+        buckets = {}
+        count = None
+        for ln in lines:
+            if "_bucket" in ln and 'path="/work"' in ln:
+                le = ln.split('le="')[1].split('"')[0]
+                buckets[le] = float(ln.rsplit(" ", 1)[1])
+            if ln.startswith("app_http_response_count") \
+                    and 'path="/work"' in ln:
+                count = float(ln.rsplit(" ", 1)[1])
+        assert count == 3.0
+        assert buckets, f"no buckets found in:\n{text[:800]}"
+        values = [buckets[k] for k in buckets]
+        assert values == sorted(values)       # cumulative
+        assert buckets.get("+Inf") == count   # closes at _count
+    run(main())
+
+
+def test_span_attributes_and_status_on_error(mock_container):
+    tracer = mock_container.tracer
+    with tracer.start_span("outer") as outer:
+        outer.set_attribute("k", "v")
+        try:
+            with tracer.start_span("inner"):
+                raise ValueError("boom")
+        except ValueError:
+            pass
+    assert outer.attributes["k"] == "v"
+    assert outer.end is not None
+
+
+def test_subscriber_span_and_commit(mock_container):
+    """The app's subscriber loop spans each message and commits only on
+    handler success (subscriber.go:27-57 semantics)."""
+    from gofr_tpu.app import App
+    container = new_mock_container({"PUBSUB_BACKEND": "INMEM"})
+    app = App(config=container.config, container=container)
+    app.http_port = 0
+    app.metrics_port = 0
+    outcomes = []
+
+    def handler(ctx):
+        data = ctx.bind()
+        if data.get("explode"):
+            raise RuntimeError("handler failure")
+        outcomes.append(data["n"])
+
+    app.subscribe("jobs", handler)
+
+    async def main():
+        await app.start()
+        try:
+            container.pubsub.publish("jobs", json.dumps({"n": 1}).encode())
+            container.pubsub.publish(
+                "jobs", json.dumps({"explode": True}).encode())
+            container.pubsub.publish("jobs", json.dumps({"n": 2}).encode())
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while outcomes != [1, 2]:
+                if asyncio.get_running_loop().time() > deadline:
+                    break
+                await asyncio.sleep(0.02)
+            assert outcomes == [1, 2]   # failure isolated, loop continued
+        finally:
+            await app.stop()
+    run(main())
