@@ -55,6 +55,12 @@ class LlamaConfig:
     # skipping — required beyond ~8K context on one core; falls back to the
     # dense einsum when shapes don't meet TPU tiling constraints
     use_flash: bool = False
+    # pallas decode attention (ops/pallas/decode_attention): numerics
+    # verified, but MEASURED ~5x SLOWER end-to-end at 7B geometry — a
+    # pallas_call per layer inside the decode scan breaks XLA's weight
+    # prefetch pipeline. Default off; kept as the starting point for a
+    # fused whole-step kernel (see that module's post-mortem).
+    use_flash_decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -253,8 +259,13 @@ def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
         v_cache = lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
-        attn = decode_attention_cached(q, k_cache, v_cache, k[:, 0], v[:, 0],
-                                       cache_len)
+        if cfg.use_flash_decode:
+            from gofr_tpu.ops.pallas import flash_decode_attention
+            attn = flash_decode_attention(q, k_cache, v_cache, k[:, 0],
+                                          v[:, 0], cache_len)
+        else:
+            attn = decode_attention_cached(q, k_cache, v_cache, k[:, 0],
+                                           v[:, 0], cache_len)
         x = x + qmm(attn.reshape(b, 1, -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
         x = x + _ffn(layer, h)

@@ -1,5 +1,6 @@
 """Pallas TPU kernels for the hot ops (see pallas_guide.md)."""
 
+from gofr_tpu.ops.pallas.decode_attention import flash_decode_attention
 from gofr_tpu.ops.pallas.flash_attention import flash_attention
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_decode_attention"]
