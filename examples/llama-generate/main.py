@@ -11,7 +11,12 @@ shards params with gofr_tpu.parallel.llama_param_specs (Megatron column/row
 specs) and the KV cache with llama_cache_specs (slots on dp, kv-heads on
 tp); XLA inserts the all-reduces over ICI.
 
-POST /generate {"prompt": "...", "max_new_tokens": 32}
+POST /generate {"prompt": "...", "max_new_tokens": 32,
+                "temperature": 0.8, "top_k": 40, "top_p": 0.95, "seed": 1}
+POST /generate/stream — same body, Server-Sent-Events: one ``data:`` frame
+per token as it is decoded (time-to-first-token = prefill latency), then a
+final ``[DONE]`` frame. gRPC analog: server-streaming
+``/gofr.Llama/generate`` (one JSON message per token).
 """
 import os
 import sys
@@ -63,16 +68,90 @@ def build_app():
         await engine.warmup(prompt_counts=(1, engine.max_slots))
         await engine.start()
 
+    from gofr_tpu.http.errors import HTTPError
+    from gofr_tpu.tpu.generate import Sampling
+
+    class BadRequest(HTTPError):
+        status_code = 400
+
+    def parse_request(data):
+        try:
+            prompt_ids = tokenizer.encode(data["prompt"])[-512:]
+            max_new = int(data.get("max_new_tokens", 32))
+            seed = data.get("seed")
+            # seed omitted → fresh entropy per request (two sampled
+            # requests differ); an explicit seed reproduces a completion
+            sampling = Sampling(
+                temperature=float(data.get("temperature", 0.0)),
+                top_k=int(data.get("top_k", 0)),
+                top_p=float(data.get("top_p", 1.0)),
+                seed=int(seed) if seed is not None else None)
+        except KeyError as exc:
+            raise BadRequest(f"missing field: {exc}") from exc
+        except (TypeError, ValueError) as exc:
+            raise BadRequest(f"bad field value: {exc}") from exc
+        return prompt_ids, max_new, sampling
+
+    async def start_stream(data):
+        """Validate + admit eagerly so bad requests fail with a 400 before
+        any stream bytes are written."""
+        prompt_ids, max_new, sampling = parse_request(data)
+        try:
+            return await engine.generate_stream(
+                prompt_ids, max_new_tokens=max_new, sampling=sampling)
+        except ValueError as exc:
+            raise BadRequest(str(exc)) from exc
+
     async def generate(ctx):
         await engine.start()  # idempotent; binds to the serving loop
-        data = ctx.bind()
-        prompt_ids = tokenizer.encode(data["prompt"])[-512:]
-        max_new = int(data.get("max_new_tokens", 32))
-        out = await engine.generate(prompt_ids, max_new_tokens=max_new)
+        prompt_ids, max_new, sampling = parse_request(ctx.bind())
+        try:
+            out = await engine.generate(prompt_ids, max_new_tokens=max_new,
+                                        sampling=sampling)
+        except ValueError as exc:
+            raise BadRequest(str(exc)) from exc
         return {"completion": tokenizer.decode(out),
                 "tokens": out, "engine": engine.stats()}
 
+    async def generate_stream(ctx):
+        from gofr_tpu.http.response import Stream
+        await engine.start()
+        stream = await start_stream(ctx.bind())
+
+        async def frames():
+            import json
+            try:
+                async for token in stream:
+                    yield json.dumps({"token": token,
+                                      "text": tokenizer.decode([token])})
+                yield "[DONE]"
+            finally:
+                # client disconnect acloses frames(); propagate to the
+                # engine stream so the slot stops decoding
+                await stream.aclose()
+
+        # on_close covers the one path frames()'s finally cannot: the
+        # client vanishing before the response writer ever starts the
+        # generator (an unstarted generator's aclose skips the body)
+        return Stream(frames(), sse=True, on_close=stream.cancel)
+
+    async def generate_grpc_stream(ctx):
+        await engine.start()
+        stream = await start_stream(ctx.request.payload)
+
+        async def tokens():
+            try:
+                async for token in stream:
+                    yield {"token": token,
+                           "text": tokenizer.decode([token])}
+            finally:
+                await stream.aclose()   # RPC cancelled → free the slot
+
+        return tokens()
+
     app.post("/generate", generate)
+    app.post("/generate/stream", generate_stream)
+    app.register_grpc_stream("Llama", "generate", generate_grpc_stream)
     return app
 
 

@@ -191,6 +191,14 @@ class App:
         framework; see gofr_tpu/grpcx)."""
         self._grpc_services.append((("dynamic", service, method), handler))
 
+    def register_grpc_stream(self, service: str, method: str,
+                             handler: Handler) -> None:
+        """Register a dynamic JSON server-streaming RPC: the handler returns
+        an async iterator and each item is sent as its own message — the
+        token-streaming serve surface (BASELINE.md config 3)."""
+        self._grpc_services.append(
+            (("dynamic_stream", service, method), handler))
+
     # -- CLI mode (gofr.go:266-268, cmd.go) ---------------------------------
     def sub_command(self, pattern: str, handler: Handler,
                     description: str = "", help_text: str = "") -> None:

@@ -55,15 +55,27 @@ def logging_middleware(logger: Logger) -> Middleware:
                 body = json.dumps(
                     {"error": {"message": "some unexpected error has occurred"}}
                 ).encode()
-            duration_us = int((time.perf_counter() - start) * 1e6)
             if trace_id:
                 headers.setdefault("X-Correlation-ID", trace_id)
-            entry = RequestLog(trace_id, request.method, request.path,
-                               status, duration_us, request.remote_addr)
-            if status >= 500:
-                logger.error("request failed", payload=entry)
+
+            def emit(status: int) -> None:
+                duration_us = int((time.perf_counter() - start) * 1e6)
+                entry = RequestLog(trace_id, request.method, request.path,
+                                   status, duration_us, request.remote_addr)
+                if status >= 500:
+                    logger.error("request failed", payload=entry)
+                else:
+                    logger.info("request", payload=entry)
+
+            from gofr_tpu.http.response import StreamBody
+            if isinstance(body, StreamBody):
+                # log when the stream finishes: true duration, and a 500
+                # if the producer died mid-stream
+                body.on_complete(
+                    lambda ok, messages, status=status:
+                        emit(status if ok else 500))
             else:
-                logger.info("request", payload=entry)
+                emit(status)
             return status, headers, body
         return handle
     return middleware

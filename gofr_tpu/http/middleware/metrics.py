@@ -17,10 +17,25 @@ def metrics_middleware(manager: Manager) -> Middleware:
         async def handle(request):
             start = time.perf_counter()
             status, headers, body = await next_handler(request)
-            manager.record_histogram(
-                "app_http_response", time.perf_counter() - start,
-                path=request.path, method=request.method, status=str(status),
-            )
+            from gofr_tpu.http.response import StreamBody
+            if isinstance(body, StreamBody):
+                # a stream's latency is its full production time, and a
+                # producer failure mid-stream is a 500, not the header
+                # status — observe at completion instead of header time
+                def observe(ok: bool, messages: int,
+                            status=status) -> None:
+                    manager.record_histogram(
+                        "app_http_response", time.perf_counter() - start,
+                        path=request.path, method=request.method,
+                        status=str(status if ok else 500))
+
+                body.on_complete(observe)
+            else:
+                manager.record_histogram(
+                    "app_http_response", time.perf_counter() - start,
+                    path=request.path, method=request.method,
+                    status=str(status),
+                )
             return status, headers, body
         return handle
     return middleware

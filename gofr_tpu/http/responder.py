@@ -12,7 +12,8 @@ from dataclasses import asdict, is_dataclass
 from typing import Any, Dict, Optional, Tuple
 
 from gofr_tpu.http.errors import HTTPError
-from gofr_tpu.http.response import FileResponse, Raw, Redirect, Response
+from gofr_tpu.http.response import (FileResponse, Raw, Redirect, Response,
+                                    Stream, StreamBody)
 
 
 def _jsonable(obj: Any) -> Any:
@@ -51,6 +52,19 @@ class Responder:
             body = json.dumps(_jsonable(result.data)).encode()
             headers.setdefault("Content-Type",
                                result.content_type or "application/json")
+            return result.status_code, headers, body
+
+        if isinstance(result, Stream):
+            headers = dict(result.headers)
+            headers.setdefault(
+                "Content-Type",
+                "text/event-stream" if result.sse else result.content_type)
+            if result.sse:
+                headers.setdefault("Cache-Control", "no-cache")
+            body = StreamBody(result.chunks, sse=result.sse)
+            if result.on_close is not None:
+                on_close = result.on_close
+                body.on_complete(lambda ok, messages: on_close())
             return result.status_code, headers, body
 
         if isinstance(result, FileResponse):

@@ -15,6 +15,7 @@ import asyncio
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
 from gofr_tpu.http.request import Request
+from gofr_tpu.http.response import StreamBody
 
 Dispatch = Callable[[Request], Awaitable[Tuple[int, Dict[str, str], bytes]]]
 
@@ -80,6 +81,12 @@ class _HTTPProtocol(asyncio.Protocol):
                 status, headers, body = await self.server.dispatch(request)
                 keep_alive = request.headers.get("connection", "").lower() != "close"
                 upgrade = request.context_values.get("upgrade_protocol")
+                if isinstance(body, StreamBody):
+                    keep_alive = await self._write_stream(
+                        status, headers, body, keep_alive)
+                    if not keep_alive:
+                        break
+                    continue
                 self._write_response(status, headers, body,
                                      keep_alive and upgrade is None)
                 if upgrade is not None and status == 101:
@@ -151,25 +158,108 @@ class _HTTPProtocol(asyncio.Protocol):
         self._data_event.clear()
         await self._data_event.wait()
 
-    def _write_response(self, status: int, headers: Dict[str, str],
-                        body: bytes, keep_alive: bool) -> None:
-        if self.transport is None or self.transport.is_closing():
-            return
+    @staticmethod
+    def _serialize_head(status: int, headers: Dict[str, str],
+                        extra: Tuple[str, ...] = (),
+                        skip: Tuple[str, ...] = ()) -> Tuple[str, bool]:
+        """Serialize the status line + headers. Returns (head text without
+        the final blank line, whether a Connection header was present).
+        ``skip`` filters caller-managed headers; ``extra`` appends raw
+        header lines."""
         reason = _STATUS_TEXT.get(status, "Unknown")
         parts = [f"HTTP/1.1 {status} {reason}\r\n"]
         sent_connection = False
         for name, value in headers.items():
-            if name.lower() == "connection":
+            low = name.lower()
+            if low in skip:
+                continue
+            if low == "connection":
                 sent_connection = True
             parts.append(f"{name}: {value}\r\n")
+        parts.extend(extra)
+        return "".join(parts), sent_connection
+
+    async def _write_stream(self, status: int, headers: Dict[str, str],
+                            body: StreamBody, keep_alive: bool) -> bool:
+        """Write a chunked-transfer response, flushing each item of the
+        async iterator as its own chunk (SSE items get ``data:`` framing).
+        Returns whether the connection may be kept alive: a producer error
+        mid-stream forces a close so the client sees truncation instead of
+        a silently-complete body. Fires ``body.complete(ok, messages)``
+        for middleware observers, and closes the producer iterator on
+        early exit so an abandoned stream stops generating."""
+        if self.transport is None or self.transport.is_closing():
+            if hasattr(body.chunks, "aclose"):
+                # never started: still release the producer so an admitted
+                # generation request frees its slot
+                try:
+                    await body.chunks.aclose()
+                except Exception:  # noqa: BLE001
+                    pass
+            body.complete(False, 0)
+            return False
+        head, _ = self._serialize_head(
+            status, headers,
+            extra=("Transfer-Encoding: chunked\r\n",
+                   "Connection: keep-alive\r\n" if keep_alive
+                   else "Connection: close\r\n"),
+            skip=("content-length", "connection", "transfer-encoding"))
+        self.transport.write((head + "\r\n").encode("latin-1"))
+        count = 0
+        ok = False            # stream fully delivered (terminator written)
+        client_gone = False   # client disconnected: not a server failure
+        try:
+            async for item in body.chunks:
+                if self.closed or self.transport.is_closing():
+                    client_gone = True
+                    break          # stop producing
+                if isinstance(item, str):
+                    item = item.encode()
+                if body.sse:
+                    item = b"data: " + item + b"\n\n"
+                if not item:
+                    continue
+                count += 1
+                self.transport.write(b"%x\r\n%s\r\n" % (len(item), item))
+            if not client_gone and not self.closed \
+                    and not self.transport.is_closing():
+                self.transport.write(b"0\r\n\r\n")
+                ok = True
+        except asyncio.CancelledError:
+            # connection_lost cancels the serve task mid-await: a client
+            # disconnect, not a producer failure
+            client_gone = True
+            raise
+        except Exception as exc:  # noqa: BLE001 — mid-stream failure
+            self.server.log_error(f"stream aborted for {self.peername}: "
+                                  f"{exc!r}")
+        finally:
+            if not ok and hasattr(body.chunks, "aclose"):
+                # early exit (client gone / producer error): release the
+                # producer so e.g. a generation slot stops decoding
+                try:
+                    await body.chunks.aclose()
+                except Exception:  # noqa: BLE001
+                    pass
+            # observers see ok for client disconnects too: the producer
+            # did not fail, so the header status is the honest record
+            body.complete(ok or client_gone, count)
+        return keep_alive if ok else False
+
+    def _write_response(self, status: int, headers: Dict[str, str],
+                        body: bytes, keep_alive: bool) -> None:
+        if self.transport is None or self.transport.is_closing():
+            return
+        extra = []
+        head, sent_connection = self._serialize_head(status, headers)
         if status != 101:
-            parts.append(f"Content-Length: {len(body)}\r\n")
+            extra.append(f"Content-Length: {len(body)}\r\n")
             if not sent_connection:
-                parts.append(
-                    "Connection: keep-alive\r\n" if keep_alive else "Connection: close\r\n"
-                )
-        parts.append("\r\n")
-        self.transport.write("".join(parts).encode("latin-1") + body)
+                extra.append(
+                    "Connection: keep-alive\r\n" if keep_alive
+                    else "Connection: close\r\n")
+        self.transport.write(
+            (head + "".join(extra) + "\r\n").encode("latin-1") + body)
 
 
 class HTTPServer:

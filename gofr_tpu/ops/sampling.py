@@ -1,0 +1,81 @@
+"""On-device token sampling: temperature / top-k / top-p, per sequence.
+
+The serving engine (gofr_tpu.tpu.generate) carries one row of sampling
+state per KV-cache slot, so every request can run its own temperature,
+top-k, top-p and PRNG stream while sharing the batched decode executable
+with everyone else. The Go reference has no sampling surface at all
+(SURVEY.md §2.7 — not an ML system); the design constraints here are
+XLA's, not the reference's:
+
+- **Static shapes**: per-row top-k values are data, not shape — the mask
+  is built by ranking a full descending sort of the logits, so one
+  compiled executable serves every (temperature, top_k, top_p) mix.
+- **Greedy rows stay greedy**: rows with ``temperature == 0`` resolve to
+  ``argmax`` inside the same program (`jnp.where` on the final choice),
+  so a batch may freely mix greedy and sampled requests.
+- **Per-row PRNG**: each row owns a key; callers carry the advanced keys
+  forward (split-once-per-sample discipline — a consumed key is never
+  reused, matching jax.random's contract).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Rows with temperature <= 0 are greedy; this floor only guards the
+# division for rows whose sampled branch is discarded anyway.
+_TEMP_FLOOR = 1e-6
+
+
+def sample_logits(logits: jnp.ndarray, temperature: jnp.ndarray,
+                  top_k: jnp.ndarray, top_p: jnp.ndarray,
+                  key: jax.Array) -> jnp.ndarray:
+    """Sample one token id from a single row of logits.
+
+    ``temperature`` scalar f32 (<=0 → greedy argmax); ``top_k`` scalar
+    int32 (0 → disabled); ``top_p`` scalar f32 (>=1 → disabled); ``key``
+    a PRNG key consumed by this call.
+    """
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    order = jnp.argsort(-logits)                    # descending
+    sorted_logits = jnp.take(logits, order)
+    temp = jnp.maximum(temperature, _TEMP_FLOOR)
+    scaled = sorted_logits.astype(jnp.float32) / temp
+
+    ranks = jnp.arange(vocab, dtype=jnp.int32)
+    k_eff = jnp.where(top_k > 0, top_k, vocab)
+    keep_k = ranks < k_eff
+
+    probs = jax.nn.softmax(scaled, axis=-1)
+    # nucleus rule: keep the smallest prefix whose mass reaches top_p —
+    # a token stays if the mass *before* it is still below the threshold,
+    # so the argmax token always survives even when top_p is tiny.
+    mass_before = jnp.cumsum(probs) - probs
+    keep_p = mass_before < top_p
+
+    masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+    choice = jax.random.categorical(key, masked, axis=-1)
+    sampled = jnp.take(order, choice).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def sample_batch(logits: jnp.ndarray, temperature: jnp.ndarray,
+                 top_k: jnp.ndarray, top_p: jnp.ndarray,
+                 keys: jax.Array) -> Tuple[jnp.ndarray, jax.Array]:
+    """Sample one token per row; returns ``(tokens (B,), advanced keys)``.
+
+    ``logits`` (B, V); per-row ``temperature``/``top_p`` f32 and ``top_k``
+    int32 of shape (B,); ``keys`` (B, 2) uint32 per-row PRNG keys. Each
+    row's key is split exactly once: one half is consumed by this sample,
+    the other is returned for the next step, so a slot's token stream is
+    a pure function of its seed regardless of how ticks are batched.
+    """
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)   # (B, 2, 2)
+    use, carry = split[:, 0], split[:, 1]
+    tokens = jax.vmap(sample_logits)(logits, temperature, top_k, top_p, use)
+    return tokens, carry

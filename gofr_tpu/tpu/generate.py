@@ -33,7 +33,15 @@ across v5e-8, KV-cache in HBM ... continuous batching on the generate loop"
 - Inactive slots are frozen in the decode executable (cache_len does not
   advance), so an idle slot's window never grows between requests.
 - Per-slot host state (remaining budget, eos, emitted tokens, generation
-  counter) stays in numpy; device state is (cache, cache_len, last_token).
+  counter) stays in numpy; device state is (cache, cache_len, last_token)
+  plus per-slot sampling state (temperature, top_k, top_p, PRNG key —
+  ops/sampling). A tick whose active slots are all greedy runs the same
+  argmax executable as before; any sampled slot switches the tick to the
+  sampling variant, where greedy rows still resolve to argmax in-program.
+- Tokens stream: ``generate_stream`` yields ids as each tick's fetch
+  lands (per-slot asyncio.Queue), so time-to-first-token is the prefill
+  latency, not the full completion. ``generate`` keeps the gather-all
+  future API on the same plumbing.
 
 Everything here is static-shape XLA: the engine never traces after the
 executable ladders are warm.
@@ -49,10 +57,88 @@ import numpy as np
 
 DEFAULT_PROMPT_BUCKETS = (32, 128, 512)
 
+# sentinel pushed onto a streaming queue when the request completes
+_DONE = object()
+
+
+class Sampling:
+    """Per-request sampling parameters. ``temperature <= 0`` is greedy;
+    ``top_k == 0`` and ``top_p >= 1`` disable their filters. ``seed=None``
+    (the default) draws fresh entropy so two identical sampled requests
+    differ; pass an explicit seed for reproducible completions."""
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None):
+        import os
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = (int(seed) if seed is not None
+                     else int.from_bytes(os.urandom(4), "little"))
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+class TokenStream:
+    """Async iterator over one request's generated tokens.
+
+    Owns explicit cancellation (``cancel()`` sync, ``aclose()`` async):
+    abandoning the stream frees the engine slot whether or not iteration
+    ever started — a plain async-generator ``finally`` cannot give that
+    guarantee (PEP 525: an unstarted generator's ``aclose`` skips the
+    body). HTTP/gRPC handlers can pass ``cancel`` as ``Stream.on_close``
+    so even a never-started response stream releases its slot."""
+
+    __slots__ = ("_engine", "_queue", "_future", "_done")
+
+    def __init__(self, engine: "GenerationEngine", queue: asyncio.Queue,
+                 future: asyncio.Future):
+        self._engine = engine
+        self._queue = queue
+        self._future = future
+        self._done = False
+
+    def __aiter__(self) -> "TokenStream":
+        return self
+
+    async def __anext__(self) -> int:
+        if self._done:
+            raise StopAsyncIteration
+        item = await self._queue.get()
+        if item is _DONE:
+            self._finish()
+            raise StopAsyncIteration
+        if isinstance(item, BaseException):
+            self._finish()
+            raise item
+        return item
+
+    def _finish(self) -> None:
+        self._done = True
+        # keep the engine's failure (if any) from surfacing as an
+        # "exception was never retrieved" warning on the paired future
+        if not self._future.done():
+            self._future.cancel()
+        elif not self._future.cancelled():
+            self._future.exception()
+
+    def cancel(self) -> None:
+        """Abandon the request: free its slot (or unqueue it). Idempotent;
+        safe from any completion path, including before first iteration."""
+        if not self._done:
+            self._engine._cancel_stream(self._queue)
+            self._finish()
+
+    async def aclose(self) -> None:
+        self.cancel()
+
 
 class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
-                 "inflight")
+                 "inflight", "queue", "temperature")
 
     def __init__(self):
         self.future: Optional[asyncio.Future] = None
@@ -62,6 +148,8 @@ class _Slot:
         self.active = False
         self.gen = 0          # bumped on claim: stale tick tokens are dropped
         self.inflight = 0     # tokens dispatched on device, not yet published
+        self.queue: Optional[asyncio.Queue] = None   # streaming consumers
+        self.temperature = 0.0   # host copy: picks greedy vs sampled tick
 
 
 class _Fetch:
@@ -137,6 +225,12 @@ class GenerationEngine:
                 llama.init_cache(cfg, max_slots, self.max_len))
         self.cache_len = jnp.zeros((max_slots,), jnp.int32)
         self.last_token = jnp.zeros((max_slots,), jnp.int32)
+        # per-slot sampling state (ops/sampling): scattered at admission,
+        # carried/advanced by the sampled decode executable
+        self.temps = jnp.zeros((max_slots,), jnp.float32)
+        self.top_ks = jnp.zeros((max_slots,), jnp.int32)
+        self.top_ps = jnp.ones((max_slots,), jnp.float32)
+        self.sample_keys = jnp.zeros((max_slots, 2), jnp.uint32)
 
         self._slots = [_Slot() for _ in range(max_slots)]
         self._free: List[int] = list(range(max_slots))
@@ -148,6 +242,7 @@ class GenerationEngine:
         self.max_inflight_ticks = max(1, int(max_inflight_ticks))
         self._publishq: "deque" = deque()   # FIFO of _Fetch entries
         self._ticks_inflight = 0
+        self._cancelled_queues: set = set()  # ids of abandoned stream queues
 
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._insert_fns: Dict[Tuple[int, int], Any] = {}
@@ -156,79 +251,130 @@ class GenerationEngine:
     # -- compiled steps -----------------------------------------------------
     def _prefill_fn(self, nb: int, lb: int):
         """Pure-compute prompt forward for ``nb`` prompts of bucket ``lb``:
-        (params, tokens (nb,lb), lengths (nb,)) → (first_tokens (nb,),
-        k_small, v_small (L,nb,lb,Hkv,Dh)). No cache involvement, so it can
-        be dispatched while decode ticks are in flight."""
+        (params, tokens (nb,lb), lengths (nb,), temps, top_ks, top_ps,
+        seeds) → (first_tokens (nb,), k_small, v_small (L,nb,lb,Hkv,Dh),
+        keys (nb,2)). The first token is sampled per-row (greedy rows
+        resolve to argmax in-program, ops/sampling); ``keys`` are the
+        advanced per-row PRNG keys decode continues from. No cache
+        involvement, so it can be dispatched while decode ticks are in
+        flight."""
         fn = self._prefill_fns.get((nb, lb))
         if fn is None:
             jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
                                     self.cfg)
+            from gofr_tpu.ops.sampling import sample_batch
 
-            def prefill_batch(params, tokens, lengths):
+            def prefill_batch(params, tokens, lengths, temps, top_ks,
+                              top_ps, seeds):
                 small = llama.init_cache(cfg, nb, lb)
                 logits, small, _ = llama.prefill(params, cfg, tokens, small,
                                                  lengths=lengths)
-                first = logits.argmax(axis=-1).astype(jnp.int32)
-                return first, small["k"], small["v"]
+                keys = jax.vmap(jax.random.PRNGKey)(seeds)
+                first, keys = sample_batch(logits, temps, top_ks, top_ps,
+                                           keys)
+                return first, small["k"], small["v"], keys
 
             fn = jax.jit(prefill_batch)
             self._prefill_fns[(nb, lb)] = fn
         return fn
 
     def _insert_fn(self, nb: int, lb: int):
-        """Cheap scatter publishing a prefill into the big cache. Padding
-        entries carry slot index ``max_slots`` (out of bounds → dropped)."""
+        """Cheap scatter publishing a prefill into the big cache, including
+        the claimed rows' sampling state. Padding entries carry slot index
+        ``max_slots`` (out of bounds → dropped)."""
         fn = self._insert_fns.get((nb, lb))
         if fn is None:
             jax = self._jax
 
             def insert(cache, k_small, v_small, slots, lengths, first,
-                       cache_len, last_token):
+                       cache_len, last_token, temps, top_ks, top_ps,
+                       sample_keys, new_t, new_k, new_p, new_keys):
                 k = cache["k"].at[:, slots, :lb].set(k_small, mode="drop")
                 v = cache["v"].at[:, slots, :lb].set(v_small, mode="drop")
                 cache_len = cache_len.at[slots].set(lengths, mode="drop")
                 last_token = last_token.at[slots].set(first, mode="drop")
-                return {"k": k, "v": v}, cache_len, last_token
+                temps = temps.at[slots].set(new_t, mode="drop")
+                top_ks = top_ks.at[slots].set(new_k, mode="drop")
+                top_ps = top_ps.at[slots].set(new_p, mode="drop")
+                sample_keys = sample_keys.at[slots].set(new_keys,
+                                                        mode="drop")
+                return ({"k": k, "v": v}, cache_len, last_token, temps,
+                        top_ks, top_ps, sample_keys)
 
-            fn = jax.jit(insert, donate_argnums=(0, 6, 7))
+            fn = jax.jit(insert, donate_argnums=(0, 6, 7, 8, 9, 10, 11))
             self._insert_fns[(nb, lb)] = fn
         return fn
 
-    def _decode_fn(self, k_steps: int):
-        fn = self._decode_fns.get(k_steps)
+    def _decode_fn(self, k_steps: int, sampled: bool = False):
+        """Decode-tick executable. The greedy variant is the serving hot
+        path and is byte-identical to the pre-sampling design; the sampled
+        variant additionally carries per-slot (temps, top_ks, top_ps, keys)
+        and advances keys only for rows active in the tick, so a slot's
+        token stream is a pure function of its seed (ops/sampling)."""
+        fn = self._decode_fns.get((k_steps, sampled))
         if fn is None:
             jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
                                     self.cfg)
             from jax import lax
 
-            def decode_k(params, token, cache, cache_len, active):
-                def one(carry, _):
-                    token, cache, cache_len = carry
-                    logits, cache, new_len = llama.decode_step(
-                        params, cfg, token, cache, cache_len)
-                    next_token = logits.argmax(axis=-1).astype(token.dtype)
-                    # freeze inactive slots: cache_len stays put and the
-                    # carried token is unchanged (ADVICE r1: no unbounded
-                    # cache_len growth on idle slots)
-                    new_len = jnp.where(active, new_len, cache_len)
-                    next_token = jnp.where(active, next_token, token)
-                    return (next_token, cache, new_len), next_token
+            if not sampled:
+                def decode_k(params, token, cache, cache_len, active):
+                    def one(carry, _):
+                        token, cache, cache_len = carry
+                        logits, cache, new_len = llama.decode_step(
+                            params, cfg, token, cache, cache_len)
+                        next_token = logits.argmax(axis=-1).astype(
+                            token.dtype)
+                        # freeze inactive slots: cache_len stays put and the
+                        # carried token is unchanged (ADVICE r1: no unbounded
+                        # cache_len growth on idle slots)
+                        new_len = jnp.where(active, new_len, cache_len)
+                        next_token = jnp.where(active, next_token, token)
+                        return (next_token, cache, new_len), next_token
 
-                (token, cache, cache_len), tokens = lax.scan(
-                    one, (token, cache, cache_len), None, length=k_steps)
-                return tokens, cache, cache_len   # tokens: (K, B)
+                    (token, cache, cache_len), tokens = lax.scan(
+                        one, (token, cache, cache_len), None, length=k_steps)
+                    return tokens, cache, cache_len   # tokens: (K, B)
 
-            fn = jax.jit(decode_k, donate_argnums=(2, 3))
-            self._decode_fns[k_steps] = fn
+                fn = jax.jit(decode_k, donate_argnums=(2, 3))
+            else:
+                from gofr_tpu.ops.sampling import sample_batch
+
+                def decode_k_sampled(params, token, cache, cache_len,
+                                     active, temps, top_ks, top_ps, keys):
+                    def one(carry, _):
+                        token, cache, cache_len, keys = carry
+                        logits, cache, new_len = llama.decode_step(
+                            params, cfg, token, cache, cache_len)
+                        next_token, new_keys = sample_batch(
+                            logits, temps, top_ks, top_ps, keys)
+                        next_token = next_token.astype(token.dtype)
+                        new_len = jnp.where(active, new_len, cache_len)
+                        next_token = jnp.where(active, next_token, token)
+                        # inactive rows keep their key: emitted-token index
+                        # == number of participating steps, so sequences
+                        # are seed-deterministic under any tick batching
+                        keys = jnp.where(active[:, None], new_keys, keys)
+                        return (next_token, cache, new_len, keys), next_token
+
+                    (token, cache, cache_len, keys), tokens = lax.scan(
+                        one, (token, cache, cache_len, keys), None,
+                        length=k_steps)
+                    return tokens, cache, cache_len, keys
+
+                fn = jax.jit(decode_k_sampled, donate_argnums=(2, 3, 8))
+            self._decode_fns[(k_steps, sampled)] = fn
         return fn
 
     async def warmup(self, prompt_counts: Tuple[int, ...] = (1,),
-                     ks: Optional[Tuple[int, ...]] = None) -> None:
+                     ks: Optional[Tuple[int, ...]] = None,
+                     sampling: bool = False) -> None:
         """Pre-compile the decode ladder and prefill/insert executables so
         the serving path never traces (executor.warmup analog). ``ks``
         restricts which decode rungs to precompile (default: the whole
         ladder); an unwarmed rung still compiles lazily off-loop if the
-        scheduler ever picks it.
+        scheduler ever picks it. ``sampling=True`` additionally warms the
+        sampled decode variants (temperature/top-k/top-p requests).
 
         Must run before ``start()``: warmup mutates cache/cache_len/
         last_token through donated-buffer executables, and racing the
@@ -249,18 +395,32 @@ class GenerationEngine:
                     self.params, self.last_token, self.cache, self.cache_len,
                     active)
                 self.cache, self.cache_len = cache, cache_len
+                if sampling:
+                    out = self._decode_fn(k, sampled=True)(
+                        self.params, self.last_token, self.cache,
+                        self.cache_len, active, self.temps, self.top_ks,
+                        self.top_ps, self.sample_keys)
+                    _, self.cache, self.cache_len, self.sample_keys = out
             for lb in self.prompt_buckets:
                 for n in prompt_counts:
                     nb = next(x for x in self._n_ladder if x >= n)
                     toks = jnp.zeros((nb, lb), jnp.int32)
                     lens = jnp.ones((nb,), jnp.int32)
-                    first, k_small, v_small = self._prefill_fn(nb, lb)(
-                        self.params, toks, lens)
+                    zeros_f = jnp.zeros((nb,), jnp.float32)
+                    zeros_i = jnp.zeros((nb,), jnp.int32)
+                    ones_f = jnp.ones((nb,), jnp.float32)
+                    seeds = jnp.zeros((nb,), jnp.uint32)
+                    first, k_small, v_small, keys = self._prefill_fn(nb, lb)(
+                        self.params, toks, lens, zeros_f, zeros_i, ones_f,
+                        seeds)
                     slots = jnp.full((nb,), self.max_slots, jnp.int32)
-                    self.cache, self.cache_len, self.last_token = \
-                        self._insert_fn(nb, lb)(
-                            self.cache, k_small, v_small, slots, lens, first,
-                            self.cache_len, self.last_token)
+                    (self.cache, self.cache_len, self.last_token,
+                     self.temps, self.top_ks, self.top_ps,
+                     self.sample_keys) = self._insert_fn(nb, lb)(
+                        self.cache, k_small, v_small, slots, lens, first,
+                        self.cache_len, self.last_token, self.temps,
+                        self.top_ks, self.top_ps, self.sample_keys,
+                        zeros_f, zeros_i, ones_f, keys)
             self._jax.block_until_ready(self.cache)
 
         await loop.run_in_executor(None, compile_all)
@@ -279,10 +439,8 @@ class GenerationEngine:
                 pass
             self._task = None
 
-    async def generate(self, prompt_ids, max_new_tokens: int,
-                       eos_id: Optional[int] = None) -> List[int]:
-        """Generate up to ``max_new_tokens`` ids (stops early on eos_id).
-        Concurrent callers share decode steps (continuous batching)."""
+    def _validate(self, prompt_ids, max_new_tokens: int) -> Tuple[List[int],
+                                                                  int]:
         prompt = list(int(t) for t in prompt_ids)
         bucket = next((b for b in self.prompt_buckets if b >= len(prompt)),
                       None)
@@ -292,11 +450,65 @@ class GenerationEngine:
                 f"{self.prompt_buckets[-1]}")
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens exceeds cache length")
+        return prompt, bucket
+
+    async def generate(self, prompt_ids, max_new_tokens: int,
+                       eos_id: Optional[int] = None,
+                       sampling: Optional[Sampling] = None) -> List[int]:
+        """Generate up to ``max_new_tokens`` ids (stops early on eos_id).
+        Concurrent callers share decode steps (continuous batching).
+        ``sampling`` defaults to greedy decoding."""
+        prompt, bucket = self._validate(prompt_ids, max_new_tokens)
         future = asyncio.get_running_loop().create_future()
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
-                                 future))
+                                 sampling or Sampling(), future, None))
         self._wake.set()
         return await future
+
+    async def generate_stream(self, prompt_ids, max_new_tokens: int,
+                              eos_id: Optional[int] = None,
+                              sampling: Optional[Sampling] = None):
+        """Returns a :class:`TokenStream` yielding token ids as they are
+        produced. Validation and admission happen eagerly (before the
+        first ``__anext__``), so a bad request raises *here* — callers can
+        still return an error status before any stream bytes are written.
+
+        Tokens are published per tick-fetch, so the first yield lands
+        after prefill (time-to-first-token) instead of after the full
+        completion. Raises the engine failure if the request's slot dies
+        mid-flight (same semantics as ``generate``). Cancelling the stream
+        (``aclose``/``cancel`` — e.g. the HTTP client disconnected) frees
+        the request's slot instead of decoding the rest of the budget into
+        an unread queue."""
+        prompt, bucket = self._validate(prompt_ids, max_new_tokens)
+        queue: asyncio.Queue = asyncio.Queue()
+        future = asyncio.get_running_loop().create_future()
+        await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
+                                 sampling or Sampling(), future, queue))
+        self._wake.set()
+        return TokenStream(self, queue, future)
+
+    def _cancel_stream(self, queue: asyncio.Queue) -> None:
+        """Abandon the request bound to ``queue``: free its slot (in-flight
+        tick tokens are dropped via the generation counter) or, if not yet
+        admitted, mark it so admission skips it."""
+        for slot_idx, slot in enumerate(self._slots):
+            if slot.queue is queue:
+                slot.active = False
+                slot.gen += 1          # stale in-flight tokens are dropped
+                slot.inflight = 0
+                slot.queue = None
+                if slot.future is not None and not slot.future.done():
+                    slot.future.cancel()
+                if slot_idx not in self._free:
+                    self._free.append(slot_idx)
+                return
+        # not bound to a slot: either still in the admission queue, or
+        # already completed (then it can never match again — admission
+        # clears this set whenever the pending queue drains empty). The
+        # queue OBJECT is kept (not its id) so a recycled address can
+        # never cancel an unrelated request.
+        self._cancelled_queues.add(queue)
 
     @property
     def active_slots(self) -> int:
@@ -339,6 +551,17 @@ class GenerationEngine:
                     self.logger.error("generation engine tick failed: %r",
                                       exc)
                 self._fail_outstanding(exc)
+                # drain in-flight fetches BEFORE rebuilding device state:
+                # their worker threads may still be reading the old buffers,
+                # and an unawaited task would log "exception was never
+                # retrieved" (ADVICE r3)
+                for entry in self._publishq:
+                    try:
+                        await entry.task
+                    except asyncio.CancelledError:
+                        raise    # engine.stop() must still win
+                    except Exception:  # noqa: BLE001 — swallow: the
+                        pass           # caller was already failed above
                 self._publishq.clear()
                 self._ticks_inflight = 0
                 # the failed executable may have consumed donated buffers
@@ -369,11 +592,18 @@ class GenerationEngine:
             self.cache = self._jax.device_put(cache)
         self.cache_len = jnp.zeros((self.max_slots,), jnp.int32)
         self.last_token = jnp.zeros((self.max_slots,), jnp.int32)
+        self.temps = jnp.zeros((self.max_slots,), jnp.float32)
+        self.top_ks = jnp.zeros((self.max_slots,), jnp.int32)
+        self.top_ps = jnp.ones((self.max_slots,), jnp.float32)
+        self.sample_keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
         self._mask_key = None
 
     def _fail_outstanding(self, exc: BaseException) -> None:
-        """Propagate a loop failure to every waiting caller and reset the
-        slot table so the engine can keep admitting fresh requests."""
+        """Propagate a loop failure to every caller bound to an active slot
+        and reset the slot table. Requests still sitting in the admission
+        queue were never dispatched to the device, so they are left intact
+        and retried against the rebuilt device state (ADVICE r3: one bad
+        tick must not reject unrelated queued callers)."""
         for slot_idx, slot in enumerate(self._slots):
             if slot.active:
                 slot.active = False
@@ -381,12 +611,11 @@ class GenerationEngine:
                 slot.inflight = 0
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_exception(exc)
+                if slot.queue is not None:
+                    slot.queue.put_nowait(exc)
+                    slot.queue = None
                 if slot_idx not in self._free:
                     self._free.append(slot_idx)
-        while not self._pending.empty():
-            *_, future = self._pending.get_nowait()
-            if not future.done():
-                future.set_exception(exc)
 
     async def _loop_body(self, loop) -> None:
         q = self._publishq
@@ -441,26 +670,45 @@ class GenerationEngine:
         """Drain the queue into slots; one batched prefill dispatch per
         prompt-length bucket. Returns [(first_dev, [(slot, gen, row)])]
         fetch handles for the first generated tokens."""
-        requests: List[Tuple[List[int], int, int, Optional[int],
-                             asyncio.Future]] = []
+        requests: List[Tuple] = []
         while self._free[len(requests):] and not self._pending.empty():
             requests.append(self._pending.get_nowait())
         if not requests:
             return []
         jnp = self._jnp
         fetches: List[Tuple[Any, List[Tuple[int, int, int]]]] = []
-        by_bucket: Dict[int, List[Tuple[List[int], int, Optional[int],
-                                        asyncio.Future]]] = {}
-        for prompt, bucket, budget, eos_id, future in requests:
+        by_bucket: Dict[int, List[Tuple]] = {}
+        for prompt, bucket, budget, eos_id, sampling, future, queue \
+                in requests:
+            if queue is not None and queue in self._cancelled_queues:
+                # stream consumer vanished before admission: drop it
+                self._cancelled_queues.discard(queue)
+                if not future.done():
+                    future.cancel()
+                continue
             by_bucket.setdefault(bucket, []).append(
-                (prompt, budget, eos_id, future))
+                (prompt, budget, eos_id, sampling, future, queue))
+        if self._pending.empty():
+            # no queued request can match a leftover entry any more —
+            # bound the set (cancel-after-completion would otherwise leak)
+            self._cancelled_queues.clear()
+        # Phase 1: claim slots for EVERY bucket group before dispatching
+        # any prefill — if one bucket's dispatch raises, every admitted
+        # request is bound to a slot and _fail_outstanding reaches it
+        # (otherwise later buckets' callers would hang unresolved).
+        staged: List[Tuple[int, int, Any, List[Tuple[int, int, int]]]] = []
         for bucket, group in sorted(by_bucket.items()):
             nb = next(x for x in self._n_ladder if x >= len(group))
             padded = np.zeros((nb, bucket), np.int32)
             lengths = np.ones((nb,), np.int32)
             slots = np.full((nb,), self.max_slots, np.int32)  # OOB → drop
+            temps = np.zeros((nb,), np.float32)
+            top_ks = np.zeros((nb,), np.int32)
+            top_ps = np.ones((nb,), np.float32)
+            seeds = np.zeros((nb,), np.uint32)
             claimed: List[Tuple[int, int, int]] = []          # (slot,gen,row)
-            for row, (prompt, budget, eos_id, future) in enumerate(group):
+            for row, (prompt, budget, eos_id, sampling, future, queue) \
+                    in enumerate(group):
                 slot_idx = self._free.pop()
                 slot = self._slots[slot_idx]
                 slot.future = future
@@ -470,23 +718,40 @@ class GenerationEngine:
                 slot.active = True
                 slot.gen += 1
                 slot.inflight = 1          # the prefill's first token
+                slot.queue = queue
+                slot.temperature = sampling.temperature
                 padded[row, :len(prompt)] = prompt
                 lengths[row] = len(prompt)
                 slots[row] = slot_idx
+                temps[row] = max(sampling.temperature, 0.0)
+                top_ks[row] = sampling.top_k
+                top_ps[row] = sampling.top_p
+                seeds[row] = np.uint32(sampling.seed & 0xFFFFFFFF)
                 claimed.append((slot_idx, slot.gen, row))
 
             def dispatch(bucket=bucket, nb=nb, padded=padded,
-                         lengths=lengths, slots=slots):
-                first, k_small, v_small = self._prefill_fn(nb, bucket)(
-                    self.params, jnp.asarray(padded), jnp.asarray(lengths))
-                self.cache, self.cache_len, self.last_token = \
+                         lengths=lengths, slots=slots, temps=temps,
+                         top_ks=top_ks, top_ps=top_ps, seeds=seeds):
+                first, k_small, v_small, keys = self._prefill_fn(nb, bucket)(
+                    self.params, jnp.asarray(padded), jnp.asarray(lengths),
+                    jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(seeds))
+                (self.cache, self.cache_len, self.last_token, self.temps,
+                 self.top_ks, self.top_ps, self.sample_keys) = \
                     self._insert_fn(nb, bucket)(
                         self.cache, k_small, v_small, jnp.asarray(slots),
                         jnp.asarray(lengths), first,
-                        self.cache_len, self.last_token)
+                        self.cache_len, self.last_token, self.temps,
+                        self.top_ks, self.top_ps, self.sample_keys,
+                        jnp.asarray(temps), jnp.asarray(top_ks),
+                        jnp.asarray(top_ps), keys)
                 return first
 
-            # first-time compiles run off-loop; warm dispatch is ~free
+            staged.append((nb, bucket, dispatch, claimed))
+
+        # Phase 2: dispatch per bucket (first-time compiles run off-loop;
+        # warm dispatch is ~free)
+        for nb, bucket, dispatch, claimed in staged:
             if (nb, bucket) in self._prefill_fns \
                     and (nb, bucket) in self._insert_fns:
                 first_dev = dispatch()
@@ -522,10 +787,13 @@ class GenerationEngine:
                     k = rung
         active = np.zeros((self.max_slots,), bool)
         snapshot = []
+        sampled = False
         for slot_idx, slot in eligible:
             active[slot_idx] = True
             slot.inflight += k
             snapshot.append((slot_idx, slot.gen))
+            if slot.temperature > 0.0:
+                sampled = True
         # keep the mask device-resident: re-upload only when the active set
         # changed (H2D through a relay costs ~10ms; most ticks are stable)
         key = active.tobytes()
@@ -534,13 +802,20 @@ class GenerationEngine:
             self._mask_key = key
 
         def dispatch():
-            tokens_dev, self.cache, self.cache_len = self._decode_fn(k)(
-                self.params, self.last_token, self.cache, self.cache_len,
-                self._mask_dev)
+            if sampled:
+                (tokens_dev, self.cache, self.cache_len,
+                 self.sample_keys) = self._decode_fn(k, sampled=True)(
+                    self.params, self.last_token, self.cache,
+                    self.cache_len, self._mask_dev, self.temps,
+                    self.top_ks, self.top_ps, self.sample_keys)
+            else:
+                tokens_dev, self.cache, self.cache_len = self._decode_fn(k)(
+                    self.params, self.last_token, self.cache,
+                    self.cache_len, self._mask_dev)
             self.last_token = tokens_dev[-1]
             return tokens_dev
 
-        if k in self._decode_fns:
+        if (k, sampled) in self._decode_fns:
             tokens_dev = dispatch()
         else:
             tokens_dev = await loop.run_in_executor(None, dispatch)
@@ -563,10 +838,15 @@ class GenerationEngine:
         for token in tokens:
             slot.tokens.append(token)
             slot.remaining -= 1
+            if slot.queue is not None:
+                slot.queue.put_nowait(token)
             if (slot.remaining <= 0
                     or (slot.eos_id is not None and token == slot.eos_id)):
                 slot.active = False    # rest of the chunk is discarded
                 self._free.append(slot_idx)
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_result(list(slot.tokens))
+                if slot.queue is not None:
+                    slot.queue.put_nowait(_DONE)
+                    slot.queue = None
                 break

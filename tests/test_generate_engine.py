@@ -183,7 +183,8 @@ def test_engine_warmup_precompiles(setup):
     async def main():
         engine = _make_engine(cfg, params, steps_per_tick=4)
         await engine.warmup(prompt_counts=(1, 2))
-        assert sorted(engine._decode_fns) == [1, 2, 4]
+        assert sorted(engine._decode_fns) == [(1, False), (2, False),
+                                              (4, False)]
         assert set(engine._prefill_fns) == {(1, 8), (1, 16), (2, 8), (2, 16)}
         await engine.start()
         try:

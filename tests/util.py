@@ -60,6 +60,30 @@ async def http_request(port: int, method: str, path: str,
     return HTTPResult(status, resp_headers, payload)
 
 
+def parse_chunked(payload: bytes) -> bytes:
+    """Decode a chunked transfer-encoded body."""
+    out = bytearray()
+    rest = payload
+    while rest:
+        size_line, _, rest = rest.partition(b"\r\n")
+        size = int(size_line.split(b";")[0], 16)
+        if size == 0:
+            break
+        out.extend(rest[:size])
+        rest = rest[size + 2:]   # skip chunk + trailing CRLF
+    return bytes(out)
+
+
+def parse_sse(body: bytes):
+    """Split an SSE stream into its ``data:`` payload strings."""
+    events = []
+    for frame in body.split(b"\n\n"):
+        for line in frame.split(b"\n"):
+            if line.startswith(b"data: "):
+                events.append(line[len(b"data: "):].decode())
+    return events
+
+
 @contextlib.asynccontextmanager
 async def serving(app: App):
     await app.start()
