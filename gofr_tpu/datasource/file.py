@@ -32,14 +32,21 @@ class LocalFileSystem:
         self.logger = logger
         self.root = os.path.abspath(root)
         self.sandbox = sandbox
-        self._sandbox_root = self.root
+        # realpath: the confinement check must compare symlink-resolved
+        # paths, or a pre-existing symlink under root pointing outside it
+        # would pass a plain prefix test (ADVICE r3)
+        self._sandbox_root = os.path.realpath(self.root)
 
     def _full(self, name: str) -> str:
         base = name if os.path.isabs(name) else os.path.join(self.root, name)
         full = os.path.abspath(base)
         if self.sandbox:
             root = self._sandbox_root
-            if full != root and not full.startswith(root + os.sep):
+            # resolve symlinks on the deepest existing ancestor so both
+            # existing targets and to-be-created paths are checked against
+            # where they will REALLY land
+            resolved = os.path.realpath(full)
+            if resolved != root and not resolved.startswith(root + os.sep):
                 raise PermissionError(
                     f"path escapes filesystem root {root!r}: {name!r}")
         return full

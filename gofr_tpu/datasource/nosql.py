@@ -204,15 +204,68 @@ class ClickhouseClient(_Observed):
             database=config.get_or_default("CLICKHOUSE_DB", "default"))
         logger.info("clickhouse connected %s", host)
 
+    @staticmethod
+    def _bind_params(query: str, args: tuple):
+        """Map the framework's positional ``?`` placeholders onto
+        clickhouse-driver's dict form (``%(name)s`` style) — the Python
+        driver rejects positional tuples for non-insert statements
+        (ADVICE r3). Pass-throughs: no args → None; a single dict → used
+        as-is (driver-native named params); a single list/tuple-of-rows →
+        used as-is (driver-native bulk INSERT)."""
+        if not args:
+            return query, None
+        if len(args) == 1 and isinstance(args[0], dict):
+            return query, args[0]
+        if len(args) == 1 and isinstance(args[0], (list, tuple)):
+            rows = args[0]
+            if rows and isinstance(rows[0], (list, tuple, dict)):
+                return query, rows       # driver-native list of rows
+            if "?" not in query:
+                return query, [tuple(rows)]   # one flat row for INSERT
+        # Quote-aware scan: '?' inside single-quoted SQL literals is text,
+        # not a placeholder, and literal '%' must become '%%' because the
+        # driver substitutes dict params via Python %-formatting.
+        out: List[str] = []
+        params: Dict[str, Any] = {}
+        index = 0
+        in_string = False
+        for ch in query:
+            if in_string:
+                out.append("%%" if ch == "%" else ch)
+                if ch == "'":
+                    in_string = False
+            elif ch == "'":
+                in_string = True
+                out.append(ch)
+            elif ch == "%":
+                out.append("%%")
+            elif ch == "?":
+                if index >= len(args):
+                    raise NoSQLError(
+                        f"query has more '?' placeholders than the "
+                        f"{len(args)} parameters given")
+                params[f"p{index}"] = args[index]
+                out.append(f"%(p{index})s")
+                index += 1
+            else:
+                out.append(ch)
+        if index != len(args):
+            raise NoSQLError(
+                f"query has {index} '?' placeholders but {len(args)} "
+                f"parameters were given")
+        return "".join(out), params
+
     def exec(self, query: str, *args) -> None:
         start = time.perf_counter()
-        self._client.execute(query, args or None)
+        bound, params = self._bind_params(query, args)
+        self._client.execute(bound, params)
         self._observe(query, start)
 
     def select(self, entity_class: Optional[Type], query: str,
                *args) -> List[Any]:
         start = time.perf_counter()
-        rows, columns = self._client.execute(query, args or None,
+        bound, params = self._bind_params(query, args)
+        rows, columns = self._client.execute(bound, params,
                                              with_column_types=True)
         out = [dict(zip((name for name, _ in columns), row))
                for row in rows]
@@ -222,7 +275,8 @@ class ClickhouseClient(_Observed):
     def async_insert(self, query: str, *args) -> None:
         # driver exposes async inserts via settings on execute
         start = time.perf_counter()
-        self._client.execute(query, args or None,
+        bound, params = self._bind_params(query, args)
+        self._client.execute(bound, params,
                              settings={"async_insert": 1,
                                        "wait_for_async_insert": 0})
         self._observe(query, start)

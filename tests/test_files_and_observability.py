@@ -274,3 +274,25 @@ def test_cron_job_exception_isolated_and_next_runs():
             await crontab._run_job(job)
     run(main())
     assert calls.count("bad") == 2 and calls.count("good") == 2
+
+
+def test_filesystem_sandbox_resolves_symlinks(tmp_path):
+    """A pre-existing symlink under root pointing outside it must not
+    defeat the confinement check (ADVICE r3: realpath, not abspath)."""
+    import os
+    import pytest
+    from gofr_tpu.datasource.file import LocalFileSystem
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    (outside / "secret.txt").write_bytes(b"top secret")
+    root = tmp_path / "root"
+    root.mkdir()
+    os.symlink(str(outside), str(root / "link"))
+    fs = LocalFileSystem(root=str(root))
+    with pytest.raises(PermissionError):
+        fs.read("link/secret.txt")
+    with pytest.raises(PermissionError):
+        fs.create("link/new.txt", b"x")
+    # non-symlinked paths still work
+    fs.create("ok.txt", b"fine")
+    assert fs.read("ok.txt") == b"fine"

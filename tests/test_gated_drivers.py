@@ -436,3 +436,61 @@ def test_missing_drivers_raise_clear_errors():
     with pytest.raises(NoSQLError, match="clickhouse-driver"):
         new_clickhouse(container.config, container.logger,
                        container.metrics)
+
+def test_clickhouse_positional_params_become_dict(monkeypatch):
+    """clickhouse-driver only accepts dict params (%(name)s style) for
+    non-insert statements — positional '?' args must be rewritten
+    (ADVICE r3 medium)."""
+    monkeypatch.setitem(
+        sys.modules, "clickhouse_driver",
+        _module("clickhouse_driver", Client=_FakeCHClient))
+    from gofr_tpu.datasource.nosql import NoSQLError, new_clickhouse
+    container = new_mock_container({"CLICKHOUSE_HOST": "ch1"})
+    client = new_clickhouse(container.config, container.logger,
+                            container.metrics)
+    fake = _FakeCHClient.instances[-1]
+
+    client.exec("ALTER TABLE t DELETE WHERE x = ? AND s = ?", 7, "a")
+    query, params, _ = fake.executed[-1]
+    assert query == "ALTER TABLE t DELETE WHERE x = %(p0)s AND s = %(p1)s"
+    assert params == {"p0": 7, "p1": "a"}
+
+    fake.rows, fake.columns = [(1,)], [("x", "Int32")]
+    client.select(None, "SELECT x FROM t WHERE x > ?", 0)
+    query, params, _ = fake.executed[-1]
+    assert query == "SELECT x FROM t WHERE x > %(p0)s"
+    assert params == {"p0": 0}
+
+    # driver-native forms pass through untouched
+    client.exec("SELECT x FROM t WHERE x = %(v)s", {"v": 3})
+    assert fake.executed[-1][:2] == ("SELECT x FROM t WHERE x = %(v)s",
+                                     {"v": 3})
+    client.async_insert("INSERT INTO t VALUES", [(1, "a"), (2, "b")])
+    assert fake.executed[-1][1] == [(1, "a"), (2, "b")]
+    client.async_insert("INSERT INTO t VALUES", (3, "c"))
+    assert fake.executed[-1][1] == [(3, "c")]
+
+    import pytest
+    with pytest.raises(NoSQLError):
+        client.exec("SELECT ? FROM t", 1, 2)   # placeholder count mismatch
+
+
+def test_clickhouse_binding_is_quote_and_percent_aware(monkeypatch):
+    """'?' inside string literals is text, and literal '%' must be escaped
+    to survive the driver's %-format substitution (code-review r4)."""
+    monkeypatch.setitem(
+        sys.modules, "clickhouse_driver",
+        _module("clickhouse_driver", Client=_FakeCHClient))
+    from gofr_tpu.datasource.nosql import new_clickhouse
+    container = new_mock_container({"CLICKHOUSE_HOST": "ch1"})
+    client = new_clickhouse(container.config, container.logger,
+                            container.metrics)
+    fake = _FakeCHClient.instances[-1]
+
+    client.exec("SELECT x FROM t WHERE s LIKE '%ab?c%' AND x = ?", 5)
+    query, params, _ = fake.executed[-1]
+    assert query == "SELECT x FROM t WHERE s LIKE '%%ab?c%%' AND x = %(p0)s"
+    assert params == {"p0": 5}
+    # the rewritten text must survive the driver's %-formatting
+    assert (query % {"p0": 5}) == \
+        "SELECT x FROM t WHERE s LIKE '%ab?c%' AND x = 5"
