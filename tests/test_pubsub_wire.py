@@ -7,6 +7,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 import zlib
 
 import pytest
@@ -143,9 +144,12 @@ def test_mqtt_codec_symmetry():
 class FakeKafkaBroker:
     """Single-node, in-memory log; speaks Metadata v1 / Produce v2 /
     Fetch v2 / ListOffsets v1 / OffsetFetch v1 / OffsetCommit v2 /
-    CreateTopics v0 / DeleteTopics v0."""
+    CreateTopics v0 / DeleteTopics v0, plus a real group coordinator
+    (FindCoordinator/JoinGroup/SyncGroup/Heartbeat/LeaveGroup v0) with a
+    join barrier, generation fencing, and eviction of members whose
+    connection dies — enough to drive the client's full rebalance cycle."""
 
-    def __init__(self, port=0):
+    def __init__(self, port=0, join_window=1.0):
         self.server = socket.socket()
         self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if port:   # restart-on-same-port tests only: never on ephemeral
@@ -153,8 +157,12 @@ class FakeKafkaBroker:
         self.server.bind(("127.0.0.1", port))
         self.server.listen(8)
         self.port = self.server.getsockname()[1]
-        self.logs = {}      # (topic, partition) -> list[(key, value)]
-        self.offsets = {}   # (group, topic, partition) -> offset
+        self.logs = {}        # (topic, partition) -> list[(key, value)]
+        self.offsets = {}     # (group, topic, partition) -> offset
+        self.partitions = {}  # topic -> partition count
+        self.groups = {}      # group -> coordinator state
+        self.gcond = threading.Condition()
+        self.join_window = join_window
         self.running = True
         self.conns = []
         threading.Thread(target=self._accept_loop, daemon=True).start()
@@ -191,13 +199,50 @@ class FakeKafkaBroker:
                 correlation = reader.int32()
                 reader.string()          # client id
                 body = self._handle(api_key, reader, _string, _bytes,
-                                    encode_message_set, decode_message_set)
+                                    encode_message_set, decode_message_set,
+                                    conn)
                 response = struct.pack(">i", correlation) + body
                 conn.sendall(struct.pack(">i", len(response)) + response)
         except OSError:
             pass
+        finally:
+            self._evict_conn(conn)
 
-    def _handle(self, api_key, reader, _string, _bytes, enc_set, dec_set):
+    # -- group coordinator ----------------------------------------------
+    def _group(self, name):
+        group = self.groups.get(name)
+        if group is None:
+            group = {"generation": 0, "members": {}, "conns": {},
+                     "pending": {}, "pending_conns": {}, "state": "stable",
+                     "leader": None, "assignments": {}, "next": 0,
+                     "deadline": 0.0}
+            self.groups[name] = group
+        return group
+
+    def _start_rebalance(self, group):
+        group["state"] = "joining"
+        group["deadline"] = time.monotonic() + self.join_window
+        group["assignments"] = {}
+        self.gcond.notify_all()
+
+    def _evict_conn(self, conn):
+        """A dead connection is a dead member: remove it and rebalance
+        the survivors (session-timeout analog, immediate)."""
+        with self.gcond:
+            for group in self.groups.values():
+                dead = [m for m, c in group["conns"].items() if c is conn]
+                dead += [m for m, c in group["pending_conns"].items()
+                         if c is conn]
+                for member in dead:
+                    group["members"].pop(member, None)
+                    group["conns"].pop(member, None)
+                    group["pending"].pop(member, None)
+                    group["pending_conns"].pop(member, None)
+                if dead and group["members"]:
+                    self._start_rebalance(group)
+
+    def _handle(self, api_key, reader, _string, _bytes, enc_set, dec_set,
+                conn=None):
         if api_key == 3:    # Metadata
             count = reader.int32()
             topics = [reader.string() for _ in range(count)]
@@ -209,11 +254,14 @@ class FakeKafkaBroker:
             out += struct.pack(">i", 0)          # controller
             out += struct.pack(">i", len(topics))
             for topic in topics:
-                self.logs.setdefault((topic, 0), [])
+                n_parts = self.partitions.setdefault(topic, 1)
+                for p in range(n_parts):
+                    self.logs.setdefault((topic, p), [])
                 out += struct.pack(">h", 0) + _string(topic) + b"\x00"
-                out += struct.pack(">i", 1)      # one partition
-                out += struct.pack(">hii", 0, 0, 0)   # err, part, leader
-                out += struct.pack(">i", 0) + struct.pack(">i", 0)
+                out += struct.pack(">i", n_parts)
+                for p in range(n_parts):
+                    out += struct.pack(">hii", 0, p, 0)  # err, part, leader
+                    out += struct.pack(">i", 0) + struct.pack(">i", 0)
             return out
         if api_key == 0:    # Produce
             reader.int16()  # acks
@@ -266,30 +314,166 @@ class FakeKafkaBroker:
                     + struct.pack(">i", 1) + struct.pack(">iq", partition,
                                                          offset)
                     + _string(None) + struct.pack(">h", 0))
-        if api_key == 8:    # OffsetCommit
-            group = reader.string()
-            reader.int32()
-            reader.string()
+        if api_key == 8:    # OffsetCommit (generation-fenced in group mode)
+            group_name = reader.string()
+            generation = reader.int32()
+            member_id = reader.string()
             reader.int64()
             reader.int32()
             topic = reader.string()
             reader.int32()
             partition = reader.int32()
             offset = reader.int64()
-            self.offsets[(group, topic, partition)] = offset
+            error = 0
+            if generation != -1:
+                with self.gcond:
+                    group = self.groups.get(group_name)
+                    if group is None or member_id not in group["members"]:
+                        error = 25
+                    elif generation != group["generation"]:
+                        error = 22
+            if not error:
+                self.offsets[(group_name, topic, partition)] = offset
             return (struct.pack(">i", 1) + _string(topic)
-                    + struct.pack(">i", 1) + struct.pack(">ih", partition, 0))
+                    + struct.pack(">i", 1)
+                    + struct.pack(">ih", partition, error))
         if api_key == 19:   # CreateTopics
             reader.int32()
             topic = reader.string()
-            self.logs.setdefault((topic, 0), [])
+            n_parts = max(1, reader.int32())
+            self.partitions[topic] = n_parts
+            for p in range(n_parts):
+                self.logs.setdefault((topic, p), [])
             return struct.pack(">i", 1) + _string(topic) + struct.pack(">h", 0)
         if api_key == 20:   # DeleteTopics
             reader.int32()
             topic = reader.string()
             self.logs.pop((topic, 0), None)
             return struct.pack(">i", 1) + _string(topic) + struct.pack(">h", 0)
+        if api_key == 10:   # FindCoordinator
+            reader.string()
+            return (struct.pack(">h", 0) + struct.pack(">i", 0)
+                    + _string("127.0.0.1") + struct.pack(">i", self.port))
+        if api_key == 11:   # JoinGroup
+            return self._handle_join(reader, _string, _bytes, conn)
+        if api_key == 14:   # SyncGroup
+            return self._handle_sync(reader, _string, _bytes)
+        if api_key == 12:   # Heartbeat
+            group_name = reader.string()
+            generation = reader.int32()
+            member_id = reader.string()
+            with self.gcond:
+                group = self.groups.get(group_name)
+                if group is None or member_id not in group["members"]:
+                    return struct.pack(">h", 25)
+                if generation != group["generation"]:
+                    return struct.pack(">h", 22)
+                if group["state"] == "joining":
+                    return struct.pack(">h", 27)
+                return struct.pack(">h", 0)
+        if api_key == 13:   # LeaveGroup
+            group_name = reader.string()
+            member_id = reader.string()
+            with self.gcond:
+                group = self.groups.get(group_name)
+                if group and member_id in group["members"]:
+                    group["members"].pop(member_id, None)
+                    group["conns"].pop(member_id, None)
+                    if group["members"]:
+                        self._start_rebalance(group)
+            return struct.pack(">h", 0)
         raise AssertionError(f"fake broker: unhandled api {api_key}")
+
+    def _handle_join(self, reader, _string, _bytes, conn):
+        group_name = reader.string()
+        reader.int32()                       # session timeout
+        member_id = reader.string() or ""
+        reader.string()                      # protocol type
+        n_protocols = reader.int32()
+        reader.string()                      # protocol name ("range")
+        metadata = reader.raw_bytes() or b""
+        for _ in range(n_protocols - 1):
+            reader.string()
+            reader.raw_bytes()
+        with self.gcond:
+            group = self._group(group_name)
+            if member_id and member_id not in group["members"] \
+                    and member_id not in group["pending"]:
+                # coordinator lost this member (eviction/restart): it must
+                # rejoin with a fresh id
+                return (struct.pack(">h", 25) + struct.pack(">i", -1)
+                        + _string("") + _string("") + _string(member_id)
+                        + struct.pack(">i", 0))
+            if not member_id:
+                member_id = f"member-{group['next']}"
+                group["next"] += 1
+            group["pending"][member_id] = metadata
+            group["pending_conns"][member_id] = conn
+            if group["state"] != "joining":
+                self._start_rebalance(group)
+            self.gcond.notify_all()
+            # barrier: wait for every current member to rejoin, or evict
+            # stragglers at the deadline
+            while (group["state"] == "joining"
+                   and not set(group["members"]) <= set(group["pending"])
+                   and time.monotonic() < group["deadline"]):
+                self.gcond.wait(0.05)
+            if group["state"] == "joining":
+                group["members"] = dict(group["pending"])
+                group["conns"] = dict(group["pending_conns"])
+                group["pending"] = {}
+                group["pending_conns"] = {}
+                group["generation"] += 1
+                group["leader"] = sorted(group["members"])[0]
+                group["assignments"] = {}
+                group["state"] = "syncing"
+                self.gcond.notify_all()
+            if member_id not in group["members"]:
+                return (struct.pack(">h", 25) + struct.pack(">i", -1)
+                        + _string("") + _string("") + _string(member_id)
+                        + struct.pack(">i", 0))
+            out = (struct.pack(">h", 0)
+                   + struct.pack(">i", group["generation"])
+                   + _string("range") + _string(group["leader"])
+                   + _string(member_id))
+            if member_id == group["leader"]:
+                out += struct.pack(">i", len(group["members"]))
+                for mid in sorted(group["members"]):
+                    out += _string(mid) + _bytes(group["members"][mid])
+            else:
+                out += struct.pack(">i", 0)
+            return out
+
+    def _handle_sync(self, reader, _string, _bytes):
+        group_name = reader.string()
+        generation = reader.int32()
+        member_id = reader.string()
+        assignments = {}
+        for _ in range(reader.int32()):
+            mid = reader.string()
+            assignments[mid] = reader.raw_bytes() or b""
+        with self.gcond:
+            group = self._group(group_name)
+            if member_id not in group["members"]:
+                return struct.pack(">h", 25) + _bytes(b"")
+            if generation != group["generation"]:
+                return struct.pack(">h", 22) + _bytes(b"")
+            if assignments:               # the leader's sync
+                group["assignments"] = assignments
+                group["state"] = "stable"
+                self.gcond.notify_all()
+            else:                         # followers wait for the leader
+                deadline = time.monotonic() + 5.0
+                while (not group["assignments"]
+                       and group["generation"] == generation
+                       and time.monotonic() < deadline):
+                    self.gcond.wait(0.05)
+                if group["generation"] != generation:
+                    return struct.pack(">h", 22) + _bytes(b"")
+                if not group["assignments"]:
+                    return struct.pack(">h", 27) + _bytes(b"")
+            return (struct.pack(">h", 0)
+                    + _bytes(group["assignments"].get(member_id, b"")))
 
     def stop(self):
         self.running = False
