@@ -1,0 +1,60 @@
+"""HTTP server backed by Redis — parity with reference
+examples/http-server-using-redis/main.go (RedisSetHandler bulk set with
+expiry, RedisGetHandler by path param, RedisPipelineHandler batched
+commands).
+
+Run: ``python main.py`` → POST /redis {"k": "v", ...}, GET /redis/{key},
+GET /redis-pipeline. ``REDIS_HOST=memory`` (default here) uses the
+in-process engine; point it at a real server for the RESP wire client.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_app
+from gofr_tpu.http.errors import EntityNotFound
+
+REDIS_EXPIRY_SECONDS = 5 * 60
+
+
+def redis_set(ctx):
+    """Set every key/value pair from the JSON body, with expiry
+    (reference RedisSetHandler)."""
+    data = ctx.bind()
+    for key, value in data.items():
+        ctx.redis.set(key, value, ttl_seconds=REDIS_EXPIRY_SECONDS)
+    return "Successful"
+
+
+def redis_get(ctx):
+    """Fetch one key (reference RedisGetHandler)."""
+    key = ctx.path_param("key")
+    value = ctx.redis.get(key)
+    if value is None:
+        raise EntityNotFound("key", key)
+    return {key: value}
+
+
+def redis_pipeline(ctx):
+    """Run several commands in one batched round trip (reference
+    RedisPipelineHandler): the wire client sends the whole pipeline in
+    one write and reads all replies back."""
+    set_ok, value = ctx.redis.pipeline([
+        ("SET", "testKey1", "testValue1", "PX",
+         REDIS_EXPIRY_SECONDS * 1000),
+        ("GET", "testKey1"),
+    ])
+    return {"testKey1": value}
+
+
+def build_app():
+    app = new_app(os.path.join(os.path.dirname(__file__), "configs"))
+    app.post("/redis", redis_set)
+    app.get("/redis/{key}", redis_get)
+    app.get("/redis-pipeline", redis_pipeline)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

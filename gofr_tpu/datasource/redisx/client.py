@@ -114,6 +114,21 @@ class _BaseRedis:
     def flushdb(self) -> bool:
         return self._run("FLUSHDB") == "OK"
 
+    def pipeline(self, commands: List[tuple]) -> List[Any]:
+        """Run a batch of raw commands; returns one result per command.
+        An error reply occupies its slot as a ``RedisError`` instance
+        instead of aborting the batch (go-redis pipeline semantics). The
+        wire client overrides this with true RESP pipelining (one write,
+        one round trip); this base version is the sequential fallback
+        for the in-memory engine."""
+        results: List[Any] = []
+        for parts in commands:
+            try:
+                results.append(self._run(*parts))
+            except RedisError as exc:
+                results.append(exc)
+        return results
+
     def health_check(self) -> Dict[str, Any]:
         try:
             up = self.ping()
@@ -211,6 +226,42 @@ class RedisClient(_BaseRedis):
             except OSError:
                 self._connect()  # one reconnect attempt then surface
                 return self._exchange(*parts)
+
+    def pipeline(self, commands: List[tuple]) -> List[Any]:
+        """True RESP pipelining: every command is written in ONE send,
+        then all replies are read back — one network round trip for the
+        whole batch (reference RedisPipelineHandler's point). Reconnect-
+        and-reissue happens only if the transport dies before ANY reply
+        was consumed; after that, reissuing could double-apply the
+        non-idempotent prefix, so the error surfaces instead."""
+        if not commands:
+            return []
+        start = time.perf_counter()
+        payload = b"".join(self._encode(parts) for parts in commands)
+        results: List[Any] = []
+        try:
+            with self._lock:
+                try:
+                    self._sock.sendall(payload)
+                    for _ in commands:
+                        results.append(self._read_pipelined())
+                except OSError:
+                    if results:
+                        raise   # partially applied: do not re-run
+                    self._connect()
+                    results = []
+                    self._sock.sendall(payload)
+                    for _ in commands:
+                        results.append(self._read_pipelined())
+            return results
+        finally:
+            self._observe("PIPELINE", start)
+
+    def _read_pipelined(self) -> Any:
+        try:
+            return self._read_reply()
+        except RedisError as exc:
+            return exc
 
     def _health_details(self) -> Dict[str, Any]:
         return {"host": f"{self.host}:{self.port}", "db": self._db}

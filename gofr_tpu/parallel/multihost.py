@@ -66,8 +66,22 @@ def hybrid_mesh(ici_axes: Dict[str, int],
     names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
     ici_shape = tuple(ici_axes.values())
     if dcn_axes and dcn_total > 1:
+        # Backends without real slice topology (multi-process CPU — the
+        # test rig, dcn_check) report one slice for every device; when
+        # slices can't satisfy the dcn axes but processes can, fall back
+        # to mesh_utils' own process-granule layout (one process == one
+        # slice). Real shape errors still propagate.
+        slice_count = len({getattr(d, "slice_index", None)
+                           for d in jax.devices()})
+        # mesh_utils wants SAME-RANK inner/outer shapes (axis i of the
+        # result = dcn[i] * ici[i]); our distinct named axes become
+        # dcn-dims padded with trailing 1s × ici-dims padded with
+        # leading 1s, giving the dcn-outermost layout
+        inner = (1,) * len(dcn_axes) + ici_shape
+        outer = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
         devices = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, tuple(dcn_axes.values()))
+            inner, outer,
+            process_is_granule=slice_count < dcn_total)
     else:
         # single host: dcn axes degenerate to 1, plain ICI mesh
         devices = mesh_utils.create_device_mesh(ici_shape)

@@ -339,3 +339,12 @@ def test_file_row_readers(tmp_path, mock_container):
         handle.write("x,y\n1,2\n3,4\n")
     rows = list(fs.read_all(csv_path))
     assert rows[0]["x"] == "1" and rows[1]["y"] == "4"
+
+
+def test_inmemory_redis_pipeline():
+    from gofr_tpu.container import new_mock_container
+    container = new_mock_container()
+    redis = container.redis
+    results = redis.pipeline([("SET", "a", "1"), ("GET", "a"),
+                              ("INCR", "a")])
+    assert results == [True, "1", 2] or results == ["OK", "1", 2]

@@ -188,3 +188,18 @@ def test_redis_server_error_is_not_retried(fake_redis):
     incrs = [c for c in fake_redis.commands[before:] if c[0] == "INCR"]
     assert len(incrs) == 1
     client.close()
+
+
+def test_redis_wire_pipeline_single_roundtrip(fake_redis):
+    """pipeline(): all commands in one write, per-slot results; an error
+    reply fills its slot without aborting the batch."""
+    client = _wire_client(fake_redis)
+    results = client.pipeline([("SET", "k", "v"), ("PING",),
+                               ("INCR", "counter")])
+    assert results == ["OK", "PONG", 1]
+    # error reply lands in its slot as RedisError, batch continues
+    fake_redis.error_replies = 1
+    first, second = client.pipeline([("INCR", "k"), ("PING",)])
+    assert isinstance(first, RedisError)
+    assert second == "PONG"
+    client.close()
