@@ -99,6 +99,8 @@ class LocalFileSystem:
         raw = self.read(name)
         if ext == ".json":
             return JSONRowReader(raw)
+        if ext == ".jsonl":
+            return JSONLRowReader(raw)
         if ext == ".csv":
             return CSVRowReader(raw)
         return TextRowReader(raw)
@@ -116,6 +118,23 @@ class JSONRowReader(RowReader):
     def __init__(self, raw: bytes):
         doc = json.loads(raw.decode("utf-8"))
         self.rows = doc if isinstance(doc, list) else [doc]
+
+    def __iter__(self):
+        return iter(self.rows)
+
+
+class JSONLRowReader(RowReader):
+    """One JSON document per line — the LLM-dataset interchange format."""
+
+    def __init__(self, raw: bytes):
+        self.rows = []
+        for number, line in enumerate(raw.decode("utf-8").splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                self.rows.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"jsonl line {number}: {exc}") from exc
 
     def __iter__(self):
         return iter(self.rows)

@@ -319,6 +319,9 @@ class InMemoryRedis(_BaseRedis):
                 opts[opts.index("EX") + 1])
         return "OK"
 
+    def _cmd_setex(self, key, seconds, value):
+        return self._cmd_set(key, value, "EX", seconds)
+
     def _cmd_del(self, *keys):
         n = 0
         for key in keys:

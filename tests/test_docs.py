@@ -1,0 +1,105 @@
+"""Every ``python`` code block in docs/ must actually execute.
+
+The reference treats its docs tree as a first-class product surface
+(/root/reference/docs — 27 pages); this repo goes one further and CI-runs
+the snippets.  Convention:
+
+- ```` ```python ````        → executed, top to bottom, per page (blocks on
+                               one page share a namespace so later blocks
+                               can build on earlier ones).
+- ```` ```python noexec ```` → shown but not executed (needs a live broker,
+                               a real TPU slice, multiple processes, ...).
+- any other fence (bash, text, json, yaml) → never executed.
+
+``App.run`` is patched to a no-op so pages can end with the real entry
+point without blocking the suite; everything before it runs for real
+(sqlite ``:memory:``, in-process redis, the INMEM broker, JAX on the
+virtual CPU mesh from conftest.py).
+"""
+
+import ast
+import os
+import pathlib
+import re
+
+import pytest
+
+DOCS = pathlib.Path(__file__).resolve().parent.parent / "docs"
+
+_FENCE = re.compile(r"^```(\w+)?([^\n`]*)$")
+
+
+def _python_blocks(text: str):
+    """Yield (first_line_number, source, executable) for each python fence
+    (noexec blocks come back with executable=False: still syntax-checked)."""
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        match = _FENCE.match(lines[i].strip())
+        if match and match.group(1):
+            lang = match.group(1)
+            info = (match.group(2) or "").strip()
+            start = i + 1
+            j = start
+            while j < len(lines) and lines[j].strip() != "```":
+                j += 1
+            if lang == "python":
+                yield start + 1, "\n".join(lines[start:j]), \
+                    "noexec" not in info
+            i = j + 1
+        else:
+            i += 1
+
+
+def _pages():
+    assert DOCS.is_dir(), "docs/ tree missing"
+    return sorted(p for p in DOCS.rglob("*.md"))
+
+
+@pytest.mark.parametrize("page", _pages(), ids=lambda p: str(p.relative_to(DOCS)))
+def test_doc_snippets_execute(page, tmp_path, monkeypatch):
+    blocks = list(_python_blocks(page.read_text()))
+    if not blocks:
+        pytest.skip("page has no executable python blocks")
+
+    from gofr_tpu.app import App
+
+    monkeypatch.setattr(App, "run", lambda self: None)
+    monkeypatch.chdir(tmp_path)          # no ./configs: defaults only
+    # isolate env mutations a page makes (os.environ[...] = ...)
+    snapshot = dict(os.environ)
+    namespace = {"__name__": f"docs:{page.name}"}
+    try:
+        for lineno, source, executable in blocks:
+            # noexec blocks still get syntax-checked (fragments may use
+            # top-level await, hence the flag)
+            code = compile(source, f"{page}:{lineno}", "exec",
+                           flags=ast.PyCF_ALLOW_TOP_LEVEL_AWAIT)
+            if executable:
+                exec(code, namespace)    # noqa: S102 — the point of the test
+    finally:
+        for key in set(os.environ) - set(snapshot):
+            del os.environ[key]
+        os.environ.update(snapshot)
+
+
+def test_docs_tree_covers_app_surface():
+    """Every public App method must be mentioned by some doc page —
+    the VERDICT r3 'done' criterion for the docs tree."""
+    from gofr_tpu.app import App
+
+    corpus = "\n".join(p.read_text() for p in _pages())
+    public = [name for name in vars(App)
+              if not name.startswith("_") and callable(getattr(App, name))]
+    missing = [name for name in public if name not in corpus]
+    assert not missing, f"app surface undocumented: {missing}"
+
+
+def test_docs_tree_shape():
+    """Structural parity with the reference tree (quick-start /
+    advanced-guide / references) plus the TPU-native section."""
+    for section, minimum in [("quick-start", 6), ("advanced-guide", 19),
+                             ("references", 2), ("tpu", 5)]:
+        pages = list((DOCS / section).glob("*.md"))
+        assert len(pages) >= minimum, (
+            f"docs/{section}: {len(pages)} pages, want >= {minimum}")
