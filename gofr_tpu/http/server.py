@@ -37,7 +37,7 @@ _MAX_BODY_BYTES = 64 * 1024 * 1024  # generous: image payloads for classify
 
 class _HTTPProtocol(asyncio.Protocol):
     __slots__ = ("server", "transport", "buffer", "task", "peername",
-                 "ws_feed", "closed", "_data_event")
+                 "ws_feed", "closed", "busy", "_data_event")
 
     def __init__(self, server: "HTTPServer"):
         self.server = server
@@ -47,6 +47,7 @@ class _HTTPProtocol(asyncio.Protocol):
         self.peername = ""
         self.ws_feed: Optional[Callable[[bytes], None]] = None
         self.closed = False
+        self.busy = False    # between request parse and response write
 
     # -- asyncio.Protocol ---------------------------------------------------
     def connection_made(self, transport) -> None:
@@ -55,6 +56,7 @@ class _HTTPProtocol(asyncio.Protocol):
         self.peername = f"{peer[0]}:{peer[1]}" if peer else ""
         self.task = asyncio.ensure_future(self._serve_loop())
         self._data_event = asyncio.Event()
+        self.server._connections.add(self)
 
     def data_received(self, data: bytes) -> None:
         if self.ws_feed is not None:
@@ -66,6 +68,7 @@ class _HTTPProtocol(asyncio.Protocol):
     def connection_lost(self, exc) -> None:
         self.closed = True
         self._data_event.set()
+        self.server._connections.discard(self)
         if self.ws_feed is not None:
             self.ws_feed(b"")  # EOF signal
         if self.task is not None:
@@ -78,17 +81,22 @@ class _HTTPProtocol(asyncio.Protocol):
                 request = await self._read_request()
                 if request is None:
                     break
+                self.busy = True
                 status, headers, body = await self.server.dispatch(request)
                 keep_alive = request.headers.get("connection", "").lower() != "close"
+                if self.server._draining:
+                    keep_alive = False   # finish this response, then close
                 upgrade = request.context_values.get("upgrade_protocol")
                 if isinstance(body, StreamBody):
                     keep_alive = await self._write_stream(
                         status, headers, body, keep_alive)
+                    self.busy = False
                     if not keep_alive:
                         break
                     continue
                 self._write_response(status, headers, body,
                                      keep_alive and upgrade is None)
+                self.busy = False
                 if upgrade is not None and status == 101:
                     # Hand the connection over (websocket). `upgrade` is an
                     # async callable(transport, set_feed) that runs the
@@ -139,7 +147,14 @@ class _HTTPProtocol(asyncio.Protocol):
             name, _, value = line.decode("latin-1").partition(":")
             headers[name.strip().lower()] = value.strip()
 
-        length = int(headers.get("content-length", "0") or "0")
+        try:
+            length = int(headers.get("content-length", "0") or "0")
+        except ValueError:
+            self._write_response(400, {}, b"malformed content-length", False)
+            return None
+        if length < 0:
+            self._write_response(400, {}, b"malformed content-length", False)
+            return None
         if length > _MAX_BODY_BYTES:
             self._write_response(413, {}, b"body too large", False)
             return None
@@ -272,6 +287,8 @@ class HTTPServer:
         self.host = host
         self.logger = logger
         self._server: Optional[asyncio.AbstractServer] = None
+        self._connections: set = set()
+        self._draining = False
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -291,8 +308,22 @@ class HTTPServer:
     async def shutdown(self) -> None:
         if self._server is not None:
             self._server.close()
+            # Python 3.12's Server.wait_closed() waits for every live
+            # handler — a connected websocket (or an idle keep-alive
+            # client) would park shutdown forever. Graceful drain: close
+            # idle and upgraded (websocket) connections now; connections
+            # mid-request finish their response first (the serve loop
+            # sees _draining and closes after writing), so in-flight
+            # callers are never cut off with a reset.
+            self._draining = True
+            for protocol in list(self._connections):
+                if protocol.transport is None:
+                    continue
+                if protocol.ws_feed is not None or not protocol.busy:
+                    protocol.transport.close()
             await self._server.wait_closed()
             self._server = None
+            self._draining = False
 
     def log_error(self, message: str) -> None:
         if self.logger is not None:

@@ -36,7 +36,7 @@ from gofr_tpu.ops import (
     rms_norm,
     rope_table,
 )
-from gofr_tpu.ops.quant import qmm, quantize_tree
+from gofr_tpu.ops.quant import qmm, quantize_kv, quantize_tree
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,11 +56,30 @@ class LlamaConfig:
     # dense einsum when shapes don't meet TPU tiling constraints
     use_flash: bool = False
     # pallas decode attention (ops/pallas/decode_attention): numerics
-    # verified, but MEASURED ~5x SLOWER end-to-end at 7B geometry — a
+    # verified, but MEASURED ~5x SLOWER at 7B geometry — a
     # pallas_call per layer inside the decode scan breaks XLA's weight
     # prefetch pipeline. Default off; kept as the starting point for a
     # fused whole-step kernel (see that module's post-mortem).
     use_flash_decode: bool = False
+    # int8 KV cache (ops/quant.quantize_kv): per-(token, head) scales,
+    # halving the cache's HBM *footprint* — the capacity lever for longer
+    # contexts / more slots per chip. MEASURED (v5e, 7B geometry,
+    # 2026-07-30): decode is ~12% SLOWER than bf16 through plain XLA —
+    # the int8→bf16 convert does not stay fused into the attention dots,
+    # so the "saved" bytes come back as a materialized converted copy
+    # (bf16 full-window 300 tok/s vs int8 265; window-bounded 366 vs 260
+    # standalone-tick numbers). Default off: use it when the cache must
+    # fit, not to go faster; a Pallas fused dequant-attention kernel is
+    # the known fix (same conclusion as ops/pallas/decode_attention).
+    # Mutually exclusive with use_flash_decode (the flash kernel reads a
+    # bf16 cache) — enforced in __post_init__.
+    kv_int8: bool = False
+
+    def __post_init__(self):
+        if self.kv_int8 and self.use_flash_decode:
+            raise ValueError(
+                "kv_int8 and use_flash_decode are mutually exclusive: the "
+                "pallas decode kernel reads a bf16 cache")
 
     @property
     def head_dim(self) -> int:
@@ -115,9 +134,16 @@ def init(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
 
 def init_cache(cfg: LlamaConfig, batch: int,
                max_len: Optional[int] = None) -> Dict[str, jnp.ndarray]:
-    """Static-shape per-layer KV cache resident in HBM."""
+    """Static-shape per-layer KV cache resident in HBM. With
+    ``cfg.kv_int8`` the k/v arrays are int8 plus per-vector scale planes
+    ``ks``/``vs`` (L, B, T, Hkv) — half the bytes, same layout."""
     t_max = max_len or cfg.max_seq_len
     shape = (cfg.n_layers, batch, t_max, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.kv_int8:
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.ones(shape[:-1], jnp.float32),
+                "vs": jnp.ones(shape[:-1], jnp.float32)}
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
 
@@ -207,19 +233,38 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
         attend = prefill_attention
 
     def body(x, layer_and_cache):
-        layer, k_cache, v_cache = layer_and_cache
+        layer = layer_and_cache[0]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
         attn = attend(q, k, v).reshape(b, s, -1)
         x = x + qmm(attn, layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
         x = x + _ffn(layer, h)
+        if cfg.kv_int8:
+            _, k_cache, v_cache, ks_cache, vs_cache = layer_and_cache
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_cache = lax.dynamic_update_slice_in_dim(k_cache, kq, 0, axis=1)
+            v_cache = lax.dynamic_update_slice_in_dim(v_cache, vq, 0, axis=1)
+            ks_cache = lax.dynamic_update_slice_in_dim(ks_cache, ks, 0,
+                                                       axis=1)
+            vs_cache = lax.dynamic_update_slice_in_dim(vs_cache, vs, 0,
+                                                       axis=1)
+            return x, (k_cache, v_cache, ks_cache, vs_cache)
+        _, k_cache, v_cache = layer_and_cache
         k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, 0, axis=1)
         v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, 0, axis=1)
         return x, (k_cache, v_cache)
 
-    x, (k_new, v_new) = lax.scan(body, x, (params["layers"],
-                                           cache["k"], cache["v"]))
+    if cfg.kv_int8:
+        x, (k_new, v_new, ks_new, vs_new) = lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"],
+                      cache["ks"], cache["vs"]))
+        new_cache = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new}
+    else:
+        x, (k_new, v_new) = lax.scan(body, x, (params["layers"],
+                                               cache["k"], cache["v"]))
+        new_cache = {"k": k_new, "v": v_new}
     if lengths is None:
         last = x[:, -1]
         cache_len = jnp.full((b,), s, jnp.int32)
@@ -228,12 +273,12 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
         cache_len = lengths.astype(jnp.int32)
     last = rms_norm(last, params["out_norm"], cfg.norm_eps)
     logits = qmm(last, params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new}, cache_len
+    return logits, new_cache, cache_len
 
 
 def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
                 token: jnp.ndarray, cache: Dict[str, jnp.ndarray],
-                cache_len: jnp.ndarray
+                cache_len: jnp.ndarray, window: Optional[int] = None
                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
     """One decode step. token (B,) int32; returns (logits (B,V), cache,
     cache_len+1). Static shapes: scatters into the cache at cache_len.
@@ -245,41 +290,68 @@ def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
     writes only the B new (H, D) rows per layer (measured 1.6× faster
     end-to-end at 7B geometry, within 6% of a no-scatter ceiling). The
     attention still runs over (old cache + current K/V) via
-    decode_attention_cached with the scatter off its critical path."""
+    decode_attention_cached with the scatter off its critical path.
+
+    ``window`` (static) bounds the attention read to the cache's first
+    ``window`` positions — fill-bounded decode: the caller guarantees
+    every *active* row's cache_len < window, picks the executable from a
+    small window ladder, and the dead tail of the static cache is never
+    streamed from HBM (it dominates early-fill decode traffic). The
+    scatter still targets the full cache, so growing past a window rung
+    just switches executables, never moves data. With ``cfg.kv_int8`` the
+    cache is int8 + scale planes; the new row quantizes before scatter.
+    """
     b = token.shape[0]
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     positions = cache_len[:, None]                       # (B, 1)
     x = params["tok_emb"][token][:, None, :]             # (B, 1, D)
     batch_idx = jnp.arange(b)
+    int8 = cfg.kv_int8
+    carry_keys = ("k", "v", "ks", "vs") if int8 else ("k", "v")
 
     def body(carry, layer_and_idx):
-        x, k_all, v_all = carry
+        x = carry[0]
+        caches = carry[1:]
         layer, idx = layer_and_idx
-        k_cache = lax.dynamic_index_in_dim(k_all, idx, 0, keepdims=False)
-        v_cache = lax.dynamic_index_in_dim(v_all, idx, 0, keepdims=False)
+        views = [lax.dynamic_index_in_dim(c, idx, 0, keepdims=False)
+                 for c in caches]
+        if window is not None:
+            views = [v[:, :window] for v in views]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
-        if cfg.use_flash_decode:
+        if cfg.use_flash_decode and not int8:
             from gofr_tpu.ops.pallas import flash_decode_attention
-            attn = flash_decode_attention(q, k_cache, v_cache, k[:, 0],
+            attn = flash_decode_attention(q, views[0], views[1], k[:, 0],
                                           v[:, 0], cache_len)
         else:
-            attn = decode_attention_cached(q, k_cache, v_cache, k[:, 0],
-                                           v[:, 0], cache_len)
+            k_scale = views[2] if int8 else None
+            v_scale = views[3] if int8 else None
+            attn = decode_attention_cached(q, views[0], views[1], k[:, 0],
+                                           v[:, 0], cache_len,
+                                           k_scale=k_scale, v_scale=v_scale)
         x = x + qmm(attn.reshape(b, 1, -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
         x = x + _ffn(layer, h)
         # in-place scatter of the B new rows at [layer idx, b, cache_len[b]]
-        k_all = k_all.at[idx, batch_idx, cache_len].set(k[:, 0])
-        v_all = v_all.at[idx, batch_idx, cache_len].set(v[:, 0])
-        return (x, k_all, v_all), None
+        if int8:
+            kq, ks = quantize_kv(k[:, 0])
+            vq, vs = quantize_kv(v[:, 0])
+            new_rows = (kq, vq, ks, vs)
+        else:
+            new_rows = (k[:, 0], v[:, 0])
+        caches = tuple(
+            c.at[idx, batch_idx, cache_len].set(row)
+            for c, row in zip(caches, new_rows))
+        return (x,) + caches, None
 
-    (x, k_new, v_new), _ = lax.scan(
-        body, (x, cache["k"], cache["v"]),
+    carry, _ = lax.scan(
+        body, (x,) + tuple(cache[key] for key in carry_keys),
         (params["layers"], jnp.arange(cfg.n_layers)))
+    x = carry[0]
+    new_cache = dict(zip(carry_keys, carry[1:]))
     x = rms_norm(x[:, 0], params["out_norm"], cfg.norm_eps)
     logits = qmm(x, params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new}, cache_len + 1
+    return logits, new_cache, cache_len + 1
 
 
 def generate(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,

@@ -68,7 +68,8 @@ def decode_attention(q, k_cache, v_cache, cache_len) -> jnp.ndarray:
 
 
 def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
-                            cache_len) -> jnp.ndarray:
+                            cache_len, k_scale=None,
+                            v_scale=None) -> jnp.ndarray:
     """Decode attention over (prior cache entries + the current token's
     K/V), *without* requiring the scatter first.
 
@@ -82,6 +83,13 @@ def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
     q: (B, 1, Hq, D); caches: (B, Tmax, Hkv, D); k_new/v_new: (B, Hkv, D);
     cache_len: (B,) — valid entries *excluding* the current token.
     Returns (B, 1, Hq, D).
+
+    int8 KV cache (ops/quant.quantize_kv): pass ``k_cache``/``v_cache`` as
+    int8 with ``k_scale``/``v_scale`` (B, Tmax, Hkv) per-vector scales.
+    The dequant never materializes a bf16 cache copy: the int8 operand
+    upcasts in-register into the einsum and the scale folds in afterwards
+    as a rank-1 broadcast (scores × k_scale per key; probs × v_scale
+    before the value einsum) — halving the dominant HBM stream of decode.
     """
     batch, _, q_heads, head_dim = q.shape
     kv_heads = k_cache.shape[2]
@@ -90,7 +98,9 @@ def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
 
     scale = head_dim ** -0.5
     scores = jnp.einsum("bkgd,btkd->bkgt", qg,
-                        k_cache).astype(jnp.float32) * scale
+                        k_cache.astype(q.dtype)).astype(jnp.float32) * scale
+    if k_scale is not None:
+        scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, :]
     valid = jnp.arange(k_cache.shape[1])[None, None, None, :] \
         < cache_len[:, None, None, None]
     scores = jnp.where(valid, scores, _NEG_INF)
@@ -98,7 +108,12 @@ def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
                            k_new).astype(jnp.float32)[..., None] * scale
     scores = jnp.concatenate([scores, score_new], axis=-1)  # (B,K,G,T+1)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
-    probs = (probs / probs.sum(axis=-1, keepdims=True)).astype(q.dtype)
-    out = jnp.einsum("bkgt,btkd->bkgd", probs[..., :-1], v_cache)
-    out = out + jnp.einsum("bkg,bkd->bkgd", probs[..., -1], v_new)
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    probs_cache = probs[..., :-1]
+    if v_scale is not None:
+        probs_cache = probs_cache * v_scale.transpose(0, 2, 1)[:, :, None, :]
+    out = jnp.einsum("bkgt,btkd->bkgd", probs_cache.astype(q.dtype),
+                     v_cache.astype(q.dtype))
+    out = out + jnp.einsum("bkg,bkd->bkgd", probs[..., -1].astype(q.dtype),
+                           v_new)
     return out.reshape(batch, 1, q_heads, head_dim)

@@ -62,10 +62,16 @@ def llama_param_specs(tp: str = "tp") -> Dict[str, Any]:
     }
 
 
-def llama_cache_specs(dp: str = "dp", tp: str = "tp") -> Dict[str, P]:
-    """KV cache (L, B, T, Hkv, Dh): batch on dp, kv-heads on tp."""
+def llama_cache_specs(dp: str = "dp", tp: str = "tp",
+                      kv_int8: bool = False) -> Dict[str, P]:
+    """KV cache (L, B, T, Hkv, Dh): batch on dp, kv-heads on tp. int8
+    caches add per-vector scale planes (L, B, T, Hkv), sharded alike."""
     spec = P(None, dp, None, tp, None)
-    return {"k": spec, "v": spec}
+    specs = {"k": spec, "v": spec}
+    if kv_int8:
+        specs["ks"] = P(None, dp, None, tp)
+        specs["vs"] = P(None, dp, None, tp)
+    return specs
 
 
 def moe_param_specs(tp: str = "tp", ep: str = "ep") -> Dict[str, Any]:

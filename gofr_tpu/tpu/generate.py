@@ -138,7 +138,7 @@ class TokenStream:
 
 class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
-                 "inflight", "queue", "temperature")
+                 "inflight", "queue", "temperature", "fill")
 
     def __init__(self):
         self.future: Optional[asyncio.Future] = None
@@ -150,6 +150,9 @@ class _Slot:
         self.inflight = 0     # tokens dispatched on device, not yet published
         self.queue: Optional[asyncio.Queue] = None   # streaming consumers
         self.temperature = 0.0   # host copy: picks greedy vs sampled tick
+        self.fill = 0         # host mirror of device cache_len (exact: set
+                              # at admission, +k per participated tick) —
+                              # picks the attention-window rung
 
 
 class _Fetch:
@@ -171,6 +174,7 @@ class GenerationEngine:
                  steps_per_tick: int = 1,
                  max_inflight_ticks: int = 2,
                  mesh=None,
+                 window_ladder: bool = True,
                  logger=None, metrics=None):
         import jax
         import jax.numpy as jnp
@@ -196,6 +200,20 @@ class GenerationEngine:
         self._k_ladder = [1]
         while self._k_ladder[-1] * 2 <= self.steps_per_tick:
             self._k_ladder.append(self._k_ladder[-1] * 2)
+        # attention-window ladder (fill-bounded decode): rungs double from
+        # 128 up to max_len; a tick attends only the smallest rung covering
+        # every participating slot's fill + k, so early-fill decode never
+        # streams the dead tail of the static cache from HBM. The top rung
+        # is encoded as window=None (identical executable to the
+        # pre-ladder design).
+        self._window_ladder: List[Optional[int]] = [None]
+        if window_ladder and self.max_len > 128:
+            rungs = []
+            w = 128
+            while w < self.max_len:
+                rungs.append(w)
+                w *= 2
+            self._window_ladder = rungs + [None]
         # admission-count ladder: 1,2,4,... up to max_slots. max_slots is
         # always the top rung even when it is not a power of two (e.g.
         # GENERATE_SLOTS=12 or dp-rounding 9→12): _admit_pending can group
@@ -218,7 +236,8 @@ class GenerationEngine:
                 params, mesh, prune_specs(specs, mesh))
             cache = llama.init_cache(cfg, max_slots, self.max_len)
             self.cache = shard_pytree(
-                cache, mesh, prune_specs(llama_cache_specs(), mesh))
+                cache, mesh,
+                prune_specs(llama_cache_specs(kv_int8=cfg.kv_int8), mesh))
         else:
             self.params = jax.device_put(params)
             self.cache = jax.device_put(
@@ -252,7 +271,8 @@ class GenerationEngine:
     def _prefill_fn(self, nb: int, lb: int):
         """Pure-compute prompt forward for ``nb`` prompts of bucket ``lb``:
         (params, tokens (nb,lb), lengths (nb,), temps, top_ks, top_ps,
-        seeds) → (first_tokens (nb,), k_small, v_small (L,nb,lb,Hkv,Dh),
+        seeds) → (first_tokens (nb,), small cache dict (leaves
+        (L,nb,lb,...) — k/v plus int8 scale planes when cfg.kv_int8),
         keys (nb,2)). The first token is sampled per-row (greedy rows
         resolve to argmax in-program, ops/sampling); ``keys`` are the
         advanced per-row PRNG keys decode continues from. No cache
@@ -272,7 +292,7 @@ class GenerationEngine:
                 keys = jax.vmap(jax.random.PRNGKey)(seeds)
                 first, keys = sample_batch(logits, temps, top_ks, top_ps,
                                            keys)
-                return first, small["k"], small["v"], keys
+                return first, small, keys
 
             fn = jax.jit(prefill_batch)
             self._prefill_fns[(nb, lb)] = fn
@@ -286,11 +306,13 @@ class GenerationEngine:
         if fn is None:
             jax = self._jax
 
-            def insert(cache, k_small, v_small, slots, lengths, first,
+            def insert(cache, small, slots, lengths, first,
                        cache_len, last_token, temps, top_ks, top_ps,
                        sample_keys, new_t, new_k, new_p, new_keys):
-                k = cache["k"].at[:, slots, :lb].set(k_small, mode="drop")
-                v = cache["v"].at[:, slots, :lb].set(v_small, mode="drop")
+                # uniform over cache leaves: k/v (L,B,T,H,D) and — int8
+                # caches — scale planes (L,B,T,H) share the (L,B,T) prefix
+                cache = {name: cache[name].at[:, slots, :lb].set(
+                    small[name], mode="drop") for name in cache}
                 cache_len = cache_len.at[slots].set(lengths, mode="drop")
                 last_token = last_token.at[slots].set(first, mode="drop")
                 temps = temps.at[slots].set(new_t, mode="drop")
@@ -298,20 +320,23 @@ class GenerationEngine:
                 top_ps = top_ps.at[slots].set(new_p, mode="drop")
                 sample_keys = sample_keys.at[slots].set(new_keys,
                                                         mode="drop")
-                return ({"k": k, "v": v}, cache_len, last_token, temps,
+                return (cache, cache_len, last_token, temps,
                         top_ks, top_ps, sample_keys)
 
-            fn = jax.jit(insert, donate_argnums=(0, 6, 7, 8, 9, 10, 11))
+            fn = jax.jit(insert, donate_argnums=(0, 5, 6, 7, 8, 9, 10))
             self._insert_fns[(nb, lb)] = fn
         return fn
 
-    def _decode_fn(self, k_steps: int, sampled: bool = False):
+    def _decode_fn(self, k_steps: int, sampled: bool = False,
+                   window: Optional[int] = None):
         """Decode-tick executable. The greedy variant is the serving hot
         path and is byte-identical to the pre-sampling design; the sampled
         variant additionally carries per-slot (temps, top_ks, top_ps, keys)
         and advances keys only for rows active in the tick, so a slot's
-        token stream is a pure function of its seed (ops/sampling)."""
-        fn = self._decode_fns.get((k_steps, sampled))
+        token stream is a pure function of its seed (ops/sampling).
+        ``window`` (a rung of the attention-window ladder, None = full)
+        statically bounds the cache positions attention streams."""
+        fn = self._decode_fns.get((k_steps, sampled, window))
         if fn is None:
             jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
                                     self.cfg)
@@ -322,7 +347,8 @@ class GenerationEngine:
                     def one(carry, _):
                         token, cache, cache_len = carry
                         logits, cache, new_len = llama.decode_step(
-                            params, cfg, token, cache, cache_len)
+                            params, cfg, token, cache, cache_len,
+                            window=window)
                         next_token = logits.argmax(axis=-1).astype(
                             token.dtype)
                         # freeze inactive slots: cache_len stays put and the
@@ -345,7 +371,8 @@ class GenerationEngine:
                     def one(carry, _):
                         token, cache, cache_len, keys = carry
                         logits, cache, new_len = llama.decode_step(
-                            params, cfg, token, cache, cache_len)
+                            params, cfg, token, cache, cache_len,
+                            window=window)
                         next_token, new_keys = sample_batch(
                             logits, temps, top_ks, top_ps, keys)
                         next_token = next_token.astype(token.dtype)
@@ -363,12 +390,23 @@ class GenerationEngine:
                     return tokens, cache, cache_len, keys
 
                 fn = jax.jit(decode_k_sampled, donate_argnums=(2, 3, 8))
-            self._decode_fns[(k_steps, sampled)] = fn
+            self._decode_fns[(k_steps, sampled, window)] = fn
         return fn
+
+    def _pick_window(self, fills: List[int], k: int) -> Optional[int]:
+        """Smallest window rung covering every participating slot's fill
+        plus the k fused steps (None = full cache)."""
+        needed = max(fills) + k if fills else k
+        for rung in self._window_ladder:
+            if rung is None or rung >= needed:
+                return rung
+        return None
 
     async def warmup(self, prompt_counts: Tuple[int, ...] = (1,),
                      ks: Optional[Tuple[int, ...]] = None,
-                     sampling: bool = False) -> None:
+                     sampling: bool = False,
+                     windows: Optional[Tuple[Optional[int], ...]] = None
+                     ) -> None:
         """Pre-compile the decode ladder and prefill/insert executables so
         the serving path never traces (executor.warmup analog). ``ks``
         restricts which decode rungs to precompile (default: the whole
@@ -387,20 +425,25 @@ class GenerationEngine:
         loop = asyncio.get_running_loop()
         rungs = self._k_ladder if ks is None \
             else [k for k in self._k_ladder if k in ks]
+        window_rungs = self._window_ladder if windows is None \
+            else [w for w in self._window_ladder if w in windows]
 
         def compile_all():
             active = jnp.zeros((self.max_slots,), bool)
             for k in rungs:
-                tokens, cache, cache_len = self._decode_fn(k)(
-                    self.params, self.last_token, self.cache, self.cache_len,
-                    active)
-                self.cache, self.cache_len = cache, cache_len
-                if sampling:
-                    out = self._decode_fn(k, sampled=True)(
+                for window in window_rungs:
+                    tokens, cache, cache_len = self._decode_fn(
+                        k, window=window)(
                         self.params, self.last_token, self.cache,
-                        self.cache_len, active, self.temps, self.top_ks,
-                        self.top_ps, self.sample_keys)
-                    _, self.cache, self.cache_len, self.sample_keys = out
+                        self.cache_len, active)
+                    self.cache, self.cache_len = cache, cache_len
+                    if sampling:
+                        out = self._decode_fn(k, sampled=True,
+                                              window=window)(
+                            self.params, self.last_token, self.cache,
+                            self.cache_len, active, self.temps, self.top_ks,
+                            self.top_ps, self.sample_keys)
+                        _, self.cache, self.cache_len, self.sample_keys = out
             for lb in self.prompt_buckets:
                 for n in prompt_counts:
                     nb = next(x for x in self._n_ladder if x >= n)
@@ -410,14 +453,14 @@ class GenerationEngine:
                     zeros_i = jnp.zeros((nb,), jnp.int32)
                     ones_f = jnp.ones((nb,), jnp.float32)
                     seeds = jnp.zeros((nb,), jnp.uint32)
-                    first, k_small, v_small, keys = self._prefill_fn(nb, lb)(
+                    first, small, keys = self._prefill_fn(nb, lb)(
                         self.params, toks, lens, zeros_f, zeros_i, ones_f,
                         seeds)
                     slots = jnp.full((nb,), self.max_slots, jnp.int32)
                     (self.cache, self.cache_len, self.last_token,
                      self.temps, self.top_ks, self.top_ps,
                      self.sample_keys) = self._insert_fn(nb, lb)(
-                        self.cache, k_small, v_small, slots, lens, first,
+                        self.cache, small, slots, lens, first,
                         self.cache_len, self.last_token, self.temps,
                         self.top_ks, self.top_ps, self.sample_keys,
                         zeros_f, zeros_i, ones_f, keys)
@@ -586,8 +629,9 @@ class GenerationEngine:
             from gofr_tpu.parallel.sharding import (
                 llama_cache_specs, prune_specs, shard_pytree)
             self.cache = shard_pytree(
-                cache, self.mesh, prune_specs(llama_cache_specs(),
-                                              self.mesh))
+                cache, self.mesh,
+                prune_specs(llama_cache_specs(kv_int8=self.cfg.kv_int8),
+                            self.mesh))
         else:
             self.cache = self._jax.device_put(cache)
         self.cache_len = jnp.zeros((self.max_slots,), jnp.int32)
@@ -720,6 +764,7 @@ class GenerationEngine:
                 slot.inflight = 1          # the prefill's first token
                 slot.queue = queue
                 slot.temperature = sampling.temperature
+                slot.fill = len(prompt)    # device cache_len after insert
                 padded[row, :len(prompt)] = prompt
                 lengths[row] = len(prompt)
                 slots[row] = slot_idx
@@ -732,14 +777,14 @@ class GenerationEngine:
             def dispatch(bucket=bucket, nb=nb, padded=padded,
                          lengths=lengths, slots=slots, temps=temps,
                          top_ks=top_ks, top_ps=top_ps, seeds=seeds):
-                first, k_small, v_small, keys = self._prefill_fn(nb, bucket)(
+                first, small, keys = self._prefill_fn(nb, bucket)(
                     self.params, jnp.asarray(padded), jnp.asarray(lengths),
                     jnp.asarray(temps), jnp.asarray(top_ks),
                     jnp.asarray(top_ps), jnp.asarray(seeds))
                 (self.cache, self.cache_len, self.last_token, self.temps,
                  self.top_ks, self.top_ps, self.sample_keys) = \
                     self._insert_fn(nb, bucket)(
-                        self.cache, k_small, v_small, jnp.asarray(slots),
+                        self.cache, small, jnp.asarray(slots),
                         jnp.asarray(lengths), first,
                         self.cache_len, self.last_token, self.temps,
                         self.top_ks, self.top_ps, self.sample_keys,
@@ -788,12 +833,16 @@ class GenerationEngine:
         active = np.zeros((self.max_slots,), bool)
         snapshot = []
         sampled = False
+        fills = []
         for slot_idx, slot in eligible:
             active[slot_idx] = True
             slot.inflight += k
+            fills.append(slot.fill)
+            slot.fill += k       # device cache_len advances by exactly k
             snapshot.append((slot_idx, slot.gen))
             if slot.temperature > 0.0:
                 sampled = True
+        window = self._pick_window(fills, k)
         # keep the mask device-resident: re-upload only when the active set
         # changed (H2D through a relay costs ~10ms; most ticks are stable)
         key = active.tobytes()
@@ -804,18 +853,20 @@ class GenerationEngine:
         def dispatch():
             if sampled:
                 (tokens_dev, self.cache, self.cache_len,
-                 self.sample_keys) = self._decode_fn(k, sampled=True)(
+                 self.sample_keys) = self._decode_fn(
+                    k, sampled=True, window=window)(
                     self.params, self.last_token, self.cache,
                     self.cache_len, self._mask_dev, self.temps,
                     self.top_ks, self.top_ps, self.sample_keys)
             else:
-                tokens_dev, self.cache, self.cache_len = self._decode_fn(k)(
+                tokens_dev, self.cache, self.cache_len = self._decode_fn(
+                    k, window=window)(
                     self.params, self.last_token, self.cache,
                     self.cache_len, self._mask_dev)
             self.last_token = tokens_dev[-1]
             return tokens_dev
 
-        if (k, sampled) in self._decode_fns:
+        if (k, sampled, window) in self._decode_fns:
             tokens_dev = dispatch()
         else:
             tokens_dev = await loop.run_in_executor(None, dispatch)

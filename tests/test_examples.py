@@ -382,3 +382,80 @@ def test_http_server_using_redis_example():
             pipe = await http_request(port, "GET", "/redis-pipeline")
             assert pipe.json()["data"] == {"testKey1": "testValue1"}
     run(main())
+
+
+def test_websocket_chat_example_broadcast():
+    """examples/websocket-chat: two clients connect, each gets the welcome
+    message; one speaks, BOTH receive the hub broadcast (reference
+    examples/using-web-socket/main_test.go analog)."""
+    import asyncio
+    import base64
+
+    from gofr_tpu.websocket.frames import OP_TEXT, decode_frame, encode_frame
+
+    module = _load_example("websocket-chat")
+
+    async def connect(port):
+        key = base64.b64encode(os.urandom(16)).decode()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write((
+            "GET /chat HTTP/1.1\r\nHost: x\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        await writer.drain()
+        status = await reader.readuntil(b"\r\n\r\n")
+        assert b"101" in status.split(b"\r\n")[0]
+        return reader, writer
+
+    async def read_message(reader):
+        buffer = b""
+        while True:
+            buffer += await asyncio.wait_for(reader.read(4096), 10.0)
+            frame = decode_frame(buffer)
+            if frame is not None:
+                opcode, _, payload, _ = frame
+                assert opcode == OP_TEXT
+                return json.loads(payload)
+
+    async def main():
+        app = _zero_ports(module.app)
+        await app.start()
+        try:
+            port = app._http_server.bound_port
+            r1, w1 = await connect(port)
+            r2, w2 = await connect(port)
+            assert (await read_message(r1)) == {"system": "welcome"}
+            assert (await read_message(r2)) == {"system": "welcome"}
+            w1.write(encode_frame(OP_TEXT, b"hi all", mask=True))
+            await w1.drain()
+            assert (await read_message(r1)) == {"message": "hi all"}
+            assert (await read_message(r2)) == {"message": "hi all"}
+        finally:
+            # no client close first: shutdown must reap live websocket
+            # connections itself (server.py shutdown fix)
+            await asyncio.wait_for(app.stop(), 15.0)
+    run(main())
+
+
+def test_using_cron_example_jobs_fire():
+    """examples/using-cron: both jobs parse, register, and a due firing
+    runs through the real _run_job path (Context + span + isolation)."""
+    import asyncio
+    import time as _time
+
+    module = _load_example("using-cron")
+    app = module.app
+    names = {job.name for job in app.crontab.jobs}
+    assert names == {"heartbeat", "tpu-health"}
+    # "* * * * *" is always due; "*/5" only on multiples of five
+    always, five = app.crontab.jobs[0], app.crontab.jobs[1]
+    at_07 = _time.struct_time((2026, 1, 1, 12, 7, 0, 3, 1, -1))
+    at_10 = _time.struct_time((2026, 1, 1, 12, 10, 0, 3, 1, -1))
+    assert always.due(at_07) and always.due(at_10)
+    assert not five.due(at_07) and five.due(at_10)
+
+    async def main():
+        for job in app.crontab.jobs:
+            await app.crontab._run_job(job)   # real firing path, no wait
+    run(main())

@@ -183,8 +183,9 @@ def test_engine_warmup_precompiles(setup):
     async def main():
         engine = _make_engine(cfg, params, steps_per_tick=4)
         await engine.warmup(prompt_counts=(1, 2))
-        assert sorted(engine._decode_fns) == [(1, False), (2, False),
-                                              (4, False)]
+        assert sorted(engine._decode_fns) == [(1, False, None),
+                                              (2, False, None),
+                                              (4, False, None)]
         assert set(engine._prefill_fns) == {(1, 8), (1, 16), (2, 8), (2, 16)}
         await engine.start()
         try:
@@ -299,8 +300,8 @@ def test_tick_failure_resets_device_state_and_recovers(setup):
         real = engine._decode_fn
         boom = {"armed": True}
 
-        def exploding(k):
-            fn = real(k)
+        def exploding(k, sampled=False, window=None):
+            fn = real(k, sampled, window)
 
             def wrapped(*args):
                 out = fn(*args)   # consumes the donated cache for real
@@ -380,4 +381,64 @@ def test_inactive_slots_frozen(setup):
             assert lens == [0, 0, 3, 20]
         finally:
             await engine.stop()
+    asyncio.run(main())
+
+
+def test_window_ladder_token_identical(setup):
+    """Fill-bounded attention (window ladder) must not change tokens: an
+    engine whose max_len spans several window rungs produces exactly the
+    reference sequence, and actually exercises a sub-full rung."""
+    cfg, params = setup
+
+    async def main():
+        # max_len 256 > 128 → ladder [128, None]; fills stay < 128 so
+        # every tick should run the 128-window executable
+        engine = _make_engine(cfg, params, max_len=128, window_ladder=True)
+        engine.max_len = 128
+        assert engine._window_ladder == [None]  # 128 is not > 128
+        engine2 = GenerationEngine(cfg, params, max_slots=4, max_len=256,
+                                   prompt_buckets=(8, 16))
+        assert engine2._window_ladder == [128, None]
+        await engine2.start()
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            out = await asyncio.wait_for(
+                engine2.generate(prompt, max_new_tokens=6), 60.0)
+            ref = llama.generate(params, cfg,
+                                 np.asarray([prompt], np.int32), 6)
+            assert out == [int(t) for t in np.asarray(ref)[0]]
+            # the sub-full rung was used (fills stayed far below 128)
+            assert any(key[2] == 128 for key in engine2._decode_fns)
+        finally:
+            await engine2.stop()
+    asyncio.run(main())
+
+
+def test_engine_kv_int8_serves(setup):
+    """int8 KV cache through the full engine path: prefill quantizes,
+    insert scatters scale planes, decode dequantizes — output tokens match
+    the fused generate under the same quantized-cache config."""
+    cfg, params = setup
+    import dataclasses
+    cfg8 = dataclasses.replace(cfg, kv_int8=True)
+
+    async def main():
+        engine = _make_engine(cfg8, params)
+        await engine.start()
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            out = await asyncio.wait_for(
+                engine.generate(prompt, max_new_tokens=6), 60.0)
+            assert len(out) == 6
+            ref = llama.generate(params, cfg8,
+                                 np.asarray([prompt], np.int32), 6)
+            assert out == [int(t) for t in np.asarray(ref)[0]]
+            assert engine.cache["k"].dtype == jnp_int8()
+            assert "ks" in engine.cache and "vs" in engine.cache
+        finally:
+            await engine.stop()
+
+    def jnp_int8():
+        import jax.numpy as jnp
+        return jnp.int8
     asyncio.run(main())

@@ -78,21 +78,33 @@ def _wait_stable(broker, group="workers", members=2, timeout=10.0):
     raise AssertionError(f"group never stabilized with {members} members")
 
 
-async def _drain(client, topic, sink, idle_timeout=1.5):
-    """Consume until the topic goes quiet; commit every message."""
+async def _drain(client, topic, sink, expect, deadline=20.0, grace=0.6):
+    """Consume + commit until ``expect`` messages arrived, then keep
+    listening ``grace`` seconds longer so duplicates would still be
+    caught; ``deadline`` bounds the whole call on a slow machine.
+
+    Never cancels ``subscribe``: a cancelled ``wait_for`` abandons the
+    executor thread blocked on queue.get, and that orphaned get would
+    swallow the NEXT real message (the source of this module's original
+    flakiness under load). Instead a timer feeds the queue a ``None``
+    sentinel and the subscribe returns normally."""
+    loop = asyncio.get_running_loop()
+    end = time.monotonic() + deadline
     while True:
-        try:
-            message = await asyncio.wait_for(client.subscribe(topic),
-                                             idle_timeout)
-        except asyncio.TimeoutError:
-            # wait_for abandons the executor thread still blocked on
-            # queue.get; feed it a sentinel or asyncio.run hangs at
-            # shutdown waiting on the default executor
+        remaining = end - time.monotonic()
+        if remaining <= 0:
+            return
+        timeout = grace if len(sink) >= expect else remaining
+
+        def poke():
             q = client._queues.get(topic)
             if q is not None:
                 q.put_nowait(None)
-            return
-        if message is None:
+
+        handle = loop.call_later(timeout, poke)
+        message = await client.subscribe(topic)
+        handle.cancel()
+        if message is None:            # sentinel: idle window elapsed
             return
         sink.append(message)
         message.commit()
@@ -113,8 +125,8 @@ def test_two_members_split_partitions_no_double_processing():
     got1, got2 = [], []
 
     async def scenario():
-        task1 = asyncio.ensure_future(_drain(c1, "jobs", got1, 2.5))
-        task2 = asyncio.ensure_future(_drain(c2, "jobs", got2, 2.5))
+        task1 = asyncio.ensure_future(_drain(c1, "jobs", got1, expect=6))
+        task2 = asyncio.ensure_future(_drain(c2, "jobs", got2, expect=6))
         assignments = await asyncio.get_running_loop().run_in_executor(
             None, _wait_stable, broker)
         # the split itself: disjoint, covering all four partitions
@@ -160,8 +172,8 @@ def test_member_death_survivor_reclaims_partitions():
     phase1, phase2 = [], []
 
     async def scenario():
-        task1 = asyncio.ensure_future(_drain(c1, "jobs", phase1, 2.0))
-        task2 = asyncio.ensure_future(_drain(c2, "jobs", phase2, 2.0))
+        task1 = asyncio.ensure_future(_drain(c1, "jobs", phase1, expect=2))
+        task2 = asyncio.ensure_future(_drain(c2, "jobs", phase2, expect=2))
         await asyncio.get_running_loop().run_in_executor(
             None, _wait_stable, broker)
         for p in range(4):
@@ -176,7 +188,7 @@ def test_member_death_survivor_reclaims_partitions():
         for p in range(4):
             broker.logs[("jobs", p)].append((b"", f"second-p{p}".encode()))
         survivor = []
-        await _drain(c2, "jobs", survivor, 2.5)
+        await _drain(c2, "jobs", survivor, expect=4)
         return survivor
 
     try:
@@ -211,13 +223,19 @@ def test_stale_generation_commit_is_fenced():
         # second member joins → generation bumps past the held message's
         c2 = _make_client(broker, "c2")
         try:
-            consume = asyncio.ensure_future(_drain(c2, "jobs", [], 2.0))
+            consume = asyncio.ensure_future(
+                _drain(c2, "jobs", [], expect=10**6, deadline=30.0))
             await asyncio.get_running_loop().run_in_executor(
                 None, _wait_stable, broker)
             with pytest.raises(KafkaRebalance):
                 held[0].commit()
         finally:
-            consume.cancel()
+            # end the drain via its own sentinel — cancelling would orphan
+            # an executor thread blocked on queue.get and hang asyncio.run
+            q = c2._queues.get("jobs")
+            if q is not None:
+                q.put_nowait(None)
+            await consume
             c2.close()
 
     try:
@@ -244,7 +262,7 @@ def test_static_mode_fetches_all_partitions():
     got = []
 
     async def scenario():
-        await _drain(client, "jobs", got, 1.5)
+        await _drain(client, "jobs", got, expect=3)
 
     try:
         asyncio.run(scenario())

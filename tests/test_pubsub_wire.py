@@ -458,6 +458,15 @@ class FakeKafkaBroker:
                 return struct.pack(">h", 25) + _bytes(b"")
             if generation != group["generation"]:
                 return struct.pack(">h", 22) + _bytes(b"")
+            if group["state"] == "joining":
+                # a newer rebalance round began between this member's join
+                # and its sync: stabilizing now would strand the joiners
+                # of the new round (observed: leader's gen-1 sync raced a
+                # second member's first join → that member got
+                # unknown-member, re-joined under a fresh id, and the
+                # group formed with a never-heartbeating ghost). Real
+                # coordinators answer REBALANCE_IN_PROGRESS.
+                return struct.pack(">h", 27) + _bytes(b"")
             if assignments:               # the leader's sync
                 group["assignments"] = assignments
                 group["state"] = "stable"

@@ -1,0 +1,238 @@
+"""HTTP server robustness: hostile bytes, protocol edges, pipelining,
+and shutdown-with-live-connections — all over real sockets against the
+real server (VERDICT r3 #5 test-depth push)."""
+
+import asyncio
+
+from tests.util import http_request, make_app, run, serving
+
+
+def _echo_app():
+    app = make_app()
+
+    def echo(ctx):
+        return {"len": len(ctx.request.body)}
+
+    app.post("/echo", echo)
+    app.get("/ping", lambda ctx: "pong")
+    return app
+
+
+async def _raw(port: int, payload: bytes, timeout: float = 10.0) -> bytes:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(payload)
+    await writer.drain()
+    writer.write_eof()
+    try:
+        return await asyncio.wait_for(reader.read(), timeout)
+    finally:
+        writer.close()
+
+
+def test_garbage_bytes_get_400_and_server_survives():
+    app = _echo_app()
+
+    async def main():
+        async with serving(app) as port:
+            raw = await _raw(port, b"\x00\xff\x13GARBAGE\r\n\r\n")
+            assert b"400" in raw.split(b"\r\n")[0]
+            # server still serves the next, clean connection
+            ok = await http_request(port, "GET", "/ping")
+            assert ok.status == 200
+    run(main())
+
+
+def test_oversized_headers_rejected():
+    app = _echo_app()
+
+    async def main():
+        async with serving(app) as port:
+            # just past the 64 KB cap, no terminator: the server consumes
+            # everything sent, answers 400, and closes cleanly. (With many
+            # KB still in flight the close would RST and eat the response
+            # — also legitimate refusal, but unassertable.)
+            blob = b"GET /ping HTTP/1.1\r\nX-Big: " + b"a" * (65 * 1024)
+            raw = await _raw(port, blob)
+            assert b"400" in raw.split(b"\r\n")[0]
+            ok = await http_request(port, "GET", "/ping")
+            assert ok.status == 200
+    run(main())
+
+
+def test_huge_declared_body_rejected_without_reading_it():
+    """A Content-Length over the cap answers 413 immediately — the server
+    must not wait for (or buffer) the claimed 100 MB."""
+    app = _echo_app()
+
+    async def main():
+        async with serving(app) as port:
+            head = (b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: 104857600\r\n\r\n")
+            raw = await asyncio.wait_for(_raw(port, head), 5.0)
+            assert b"413" in raw.split(b"\r\n")[0]
+    run(main())
+
+
+def test_malformed_content_length_rejected():
+    app = _echo_app()
+
+    async def main():
+        async with serving(app) as port:
+            for bad in (b"banana", b"-5", b"1e3"):
+                raw = await _raw(
+                    port, b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: " + bad + b"\r\n\r\n")
+                assert b"400" in raw.split(b"\r\n")[0], bad
+            ok = await http_request(port, "GET", "/ping")
+            assert ok.status == 200
+    run(main())
+
+
+def test_keepalive_pipelined_requests_one_connection():
+    """Two requests written in ONE send must both be answered, in order,
+    on the same connection (body boundaries respected)."""
+    app = _echo_app()
+
+    async def main():
+        async with serving(app) as port:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            blob = (b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: 3\r\n\r\nabc"
+                    b"GET /ping HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: 0\r\n\r\n")
+            writer.write(blob)
+            await writer.drain()
+
+            async def read_one():
+                head = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), 10.0)
+                length = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        length = int(line.split(b":", 1)[1])
+                body = await asyncio.wait_for(reader.readexactly(length),
+                                              10.0)
+                return head.split(b"\r\n")[0], body
+
+            first_status, first_body = await read_one()
+            second_status, second_body = await read_one()
+            assert b"201" in first_status and b'"len": 3' in first_body
+            assert b"200" in second_status and b"pong" in second_body
+            writer.close()
+    run(main())
+
+
+def test_connection_close_honored():
+    app = _echo_app()
+
+    async def main():
+        async with serving(app) as port:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(b"GET /ping HTTP/1.1\r\nHost: x\r\n"
+                         b"Connection: close\r\n\r\n")
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), 10.0)  # EOF = closed
+            assert b"200" in raw.split(b"\r\n")[0]
+            assert b"Connection: close" in raw
+            writer.close()
+    run(main())
+
+
+def test_shutdown_reaps_idle_keepalive_connection():
+    """An idle keep-alive client must not park shutdown (Python 3.12's
+    Server.wait_closed waits on live handlers; server.py closes their
+    transports first)."""
+    app = _echo_app()
+
+    async def main():
+        await app.start()
+        port = app._http_server.bound_port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        # one completed request leaves the connection idle in keep-alive
+        writer.write(b"GET /ping HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 10.0)
+        # shutdown with the socket still open must complete promptly
+        await asyncio.wait_for(app.stop(), 10.0)
+        writer.close()
+    run(main())
+
+
+def test_shutdown_reaps_live_websocket():
+    """Same for an ACTIVE websocket mid-conversation (found via the
+    websocket-chat example: stop() hung until the client went away)."""
+    import base64
+    import os
+
+    app = make_app()
+
+    async def forever_echo(ctx):
+        while True:
+            message = await ctx.read_message()
+            await ctx.write_message(message)
+
+    app.websocket("/ws", forever_echo)
+
+    async def main():
+        await app.start()
+        port = app._http_server.bound_port
+        key = base64.b64encode(os.urandom(16)).decode()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write((
+            "GET /ws HTTP/1.1\r\nHost: x\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        await writer.drain()
+        status = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 10.0)
+        assert b"101" in status.split(b"\r\n")[0]
+        await asyncio.wait_for(app.stop(), 10.0)
+        writer.close()
+    run(main())
+
+
+def test_many_sequential_connections_no_leak():
+    """Churn 30 connections; the server's connection registry must drain
+    back to empty (no protocol objects leak)."""
+    app = _echo_app()
+
+    async def main():
+        async with serving(app) as port:
+            for _ in range(30):
+                ok = await http_request(port, "GET", "/ping")
+                assert ok.status == 200
+            await asyncio.sleep(0.05)
+            assert len(app._http_server._connections) == 0
+    run(main())
+
+
+def test_shutdown_lets_inflight_request_complete():
+    """Graceful drain: a request already being handled when stop() is
+    called must still get its response (connection then closes); only
+    idle connections are cut immediately."""
+    app = make_app()
+
+    async def slow(ctx):
+        await asyncio.sleep(0.4)
+        return {"done": True}
+
+    app.get("/slow", slow)
+
+    async def main():
+        await app.start()
+        port = app._http_server.bound_port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /slow HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        await asyncio.sleep(0.1)          # handler is now mid-sleep
+        stop = asyncio.ensure_future(app.stop())
+        raw = await asyncio.wait_for(reader.read(), 10.0)
+        await asyncio.wait_for(stop, 10.0)
+        assert b"200" in raw.split(b"\r\n")[0]
+        assert b'"done": true' in raw
+        # drain forces the connection closed after the response
+        assert b"Connection: close" in raw
+        writer.close()
+    run(main())
