@@ -534,10 +534,15 @@ def _llama7b_int8_bench(on_tpu: bool):
         "lm_head": qrand(8, d, cfg.vocab_size),
     }
 
+    # operating point (r4, measured sweep): 16 slots × K=16 fused steps ×
+    # 6-deep fetch pipeline = 676 tok/s on this harness vs 501 at
+    # 8×K16 and 480 at 8×K8 — weights stream once per step regardless of
+    # batch, so doubling slots nearly doubles aggregate until attention/
+    # activation compute catches up.
     container = new_mock_container()
-    engine = GenerationEngine(cfg, params, max_slots=8, max_len=512,
-                              prompt_buckets=(32,), steps_per_tick=8,
-                              max_inflight_ticks=4,
+    engine = GenerationEngine(cfg, params, max_slots=16, max_len=512,
+                              prompt_buckets=(32,), steps_per_tick=16,
+                              max_inflight_ticks=6,
                               logger=container.logger,
                               metrics=container.metrics)
 
@@ -548,56 +553,62 @@ def _llama7b_int8_bench(on_tpu: bool):
     weight_bytes = leaf_bytes({"layers": params["layers"],
                                "head": params["lm_head"]})
     cache_bytes = leaf_bytes(engine.cache)
-    # fill-bounded attention: every request here peaks at fill 16+65=81,
-    # so the engine schedules the same window rung throughout — derive it
-    # exactly as the engine will, and count only that live fraction of
-    # the cache as streamed per step (the dead tail is never read)
-    window = engine._pick_window([16 + 65], 8)
+    # fill-bounded attention: every request here peaks at fill 16+81=97,
+    # +16 fused steps < 128, so the engine schedules the 128 rung
+    # throughout — derive it exactly as the engine will, and count only
+    # that live fraction of the cache as streamed per step (the dead
+    # tail is never read)
+    budget = 81     # prefill + 80 decode = exactly 5 fused K=16 ticks
+    window = engine._pick_window([16 + budget], 16)
     window_frac = 1.0 if window is None else window / engine.max_len
     step_bytes = weight_bytes + cache_bytes * window_frac
     hbm_bw = 819e9                            # v5e spec
 
     async def run_streams():
-        # budget 65 = 1 prefill + 64 decode = exactly 8 fused K=8 ticks per
-        # slot — only the k=8 rung / one window rung is ever scheduled, so
-        # warm exactly that executable
-        await engine.warmup(prompt_counts=(8,), ks=(8,), windows=(window,))
+        await engine.warmup(prompt_counts=(16,), ks=(16,),
+                            windows=(window,))
         await engine.start()
-        # settle = 1 prefill + exactly one K=8 tick: absorbs the one-time
+        # settle = 1 prefill + exactly one K=16 tick: absorbs the one-time
         # first-execution stall (relayout after warmup's donated buffers)
         # that otherwise lands inside the timed window
         await asyncio.gather(*[
-            engine.generate([i + 1] * 16, max_new_tokens=9)
-            for i in range(8)])
+            engine.generate([i + 1] * 16, max_new_tokens=17)
+            for i in range(16)])
         start = time.perf_counter()
         outs = await asyncio.gather(*[
-            engine.generate([i + 1] * 16, max_new_tokens=65)
-            for i in range(8)])
+            engine.generate([i + 1] * 16, max_new_tokens=budget)
+            for i in range(16)])
         elapsed = time.perf_counter() - start
         await engine.stop()
         return sum(len(o) for o in outs) / elapsed
 
     tok_s = asyncio.run(run_streams())
 
-    # device-only rate: chain 10 donated K=8 ticks with ONE host sync at
-    # the end — the per-call relay round trip (see `relay` above) is paid
-    # once instead of per tick, so this approximates what a real TPU host
-    # (µs-scale dispatch) would sustain from the same executable.
-    fn = engine._decode_fn(8, window=window)
+    # device-only rate via two-point slope: time donated chains of 2 and
+    # 12 ticks, each ended by an actual token fetch (block_until_ready
+    # does not reliably barrier through the relay), and take
+    # (t12 - t2) / 10 — fixed dispatch/fetch overhead cancels, leaving
+    # the true per-tick device time a real TPU host would sustain.
+    fn = engine._decode_fn(16, window=window)
     active = jnp.zeros((engine.max_slots,), bool)
-    token, cache, cache_len = engine.last_token, engine.cache, \
-        engine.cache_len
-    tokens_dev, cache, cache_len = fn(engine.params, token, cache,
-                                      cache_len, active)   # queue warm
-    jax.block_until_ready(tokens_dev)
-    chain = 10
-    start = time.perf_counter()
-    for _ in range(chain):
-        tokens_dev, cache, cache_len = fn(engine.params, tokens_dev[-1],
-                                          cache, cache_len, active)
-    jax.block_until_ready(tokens_dev)
-    device_tick_s = (time.perf_counter() - start) / chain
-    device_tok_s = engine.max_slots * 8 / device_tick_s
+    tokens_dev, cache, cache_len = fn(engine.params, engine.last_token,
+                                      engine.cache, engine.cache_len,
+                                      active)   # queue warm
+    np.asarray(tokens_dev)
+
+    def chain(n):
+        nonlocal tokens_dev, cache, cache_len
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tokens_dev, cache, cache_len = fn(
+                engine.params, tokens_dev[-1], cache, cache_len, active)
+        np.asarray(tokens_dev)       # fetch = true barrier on this harness
+        return time.perf_counter() - t0
+
+    t2 = min(chain(2), chain(2))
+    t12 = min(chain(12), chain(12))
+    device_tick_s = max((t12 - t2) / 10, 1e-6)
+    device_tok_s = engine.max_slots * 16 / device_tick_s
 
     roofline = engine.max_slots * hbm_bw / step_bytes
     return {"decode_tok_s": round(tok_s, 1),
@@ -606,11 +617,18 @@ def _llama7b_int8_bench(on_tpu: bool):
             "device_only_tok_s": round(device_tok_s, 1),
             "device_only_roofline_frac": round(device_tok_s / roofline, 3),
             "device_tick_ms": round(device_tick_s * 1e3, 2),
+            "slots": engine.max_slots,
+            "steps_per_tick": 16,
             "weights_gb": round(weight_bytes / 2**30, 2),
             "kv_cache_gb": round(cache_bytes / 2**30, 2),
             "kv_cache_dtype": "bf16",
             "attention_window": window or engine.max_len,
-            "streamed_bytes_per_step_gb": round(step_bytes / 2**30, 2)}
+            "streamed_bytes_per_step_gb": round(step_bytes / 2**30, 2),
+            "note": ("roofline counts weights + live cache window per "
+                     "step; r3's 0.657 frac divided by full-window bytes "
+                     "— same measurement here reads lower against the "
+                     "honest (smaller) denominator while tok/s rose "
+                     "491→676")}
 
 
 if __name__ == "__main__":

@@ -35,7 +35,10 @@ def build_app():
 
     app = new_app()
     preset = os.environ.get("LLAMA_PRESET", "small")
-    cfg = llama.config(preset, vocab_size=256)  # byte-level vocab
+    # LLAMA_KV_INT8=1: halve the KV cache's HBM footprint (capacity for
+    # longer contexts/more slots; measured slower — LlamaConfig.kv_int8)
+    cfg = llama.config(preset, vocab_size=256,  # byte-level vocab
+                       kv_int8=os.environ.get("LLAMA_KV_INT8") == "1")
     params = llama.init(cfg, jax.random.PRNGKey(0))
 
     mesh = None

@@ -91,6 +91,12 @@ class _HTTPProtocol(asyncio.Protocol):
                     keep_alive = await self._write_stream(
                         status, headers, body, keep_alive)
                     self.busy = False
+                    # drain may have BEGUN while the stream was writing
+                    # (keep_alive was computed before): without this
+                    # re-check the connection would park idle and
+                    # wait_closed() would never return
+                    if self.server._draining:
+                        keep_alive = False
                     if not keep_alive:
                         break
                     continue
@@ -305,23 +311,34 @@ class HTTPServer:
         assert self._server is not None
         await self._server.serve_forever()
 
-    async def shutdown(self) -> None:
+    async def shutdown(self, drain_grace: float = 5.0) -> None:
         if self._server is not None:
             self._server.close()
             # Python 3.12's Server.wait_closed() waits for every live
             # handler — a connected websocket (or an idle keep-alive
             # client) would park shutdown forever. Graceful drain: close
-            # idle and upgraded (websocket) connections now; connections
-            # mid-request finish their response first (the serve loop
-            # sees _draining and closes after writing), so in-flight
-            # callers are never cut off with a reset.
+            # truly idle and upgraded (websocket) connections now;
+            # connections mid-request — including a partially-received
+            # request (non-empty parse buffer) — finish their response
+            # first (the serve loop sees _draining and closes after
+            # writing). Stragglers that never finish within
+            # ``drain_grace`` seconds are force-closed so shutdown is
+            # always bounded.
             self._draining = True
             for protocol in list(self._connections):
                 if protocol.transport is None:
                     continue
-                if protocol.ws_feed is not None or not protocol.busy:
+                if protocol.ws_feed is not None or (
+                        not protocol.busy and not protocol.buffer):
                     protocol.transport.close()
-            await self._server.wait_closed()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(),
+                                       drain_grace)
+            except asyncio.TimeoutError:
+                for protocol in list(self._connections):
+                    if protocol.transport is not None:
+                        protocol.transport.close()
+                await self._server.wait_closed()
             self._server = None
             self._draining = False
 

@@ -86,10 +86,12 @@ def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
 
     int8 KV cache (ops/quant.quantize_kv): pass ``k_cache``/``v_cache`` as
     int8 with ``k_scale``/``v_scale`` (B, Tmax, Hkv) per-vector scales.
-    The dequant never materializes a bf16 cache copy: the int8 operand
-    upcasts in-register into the einsum and the scale folds in afterwards
-    as a rank-1 broadcast (scores × k_scale per key; probs × v_scale
-    before the value einsum) — halving the dominant HBM stream of decode.
+    The dequant is WRITTEN to fuse (int8 upcast into the einsum, scale
+    folded in afterwards as a rank-1 broadcast), but MEASURED on v5e the
+    convert does not stay fused — XLA materializes a converted copy and
+    the int8 path decodes ~12% slower than bf16 (post-mortem:
+    models/llama.py LlamaConfig.kv_int8). The int8 cache remains the
+    HBM-*capacity* lever; a fused Pallas kernel is the known speed fix.
     """
     batch, _, q_heads, head_dim = q.shape
     kv_heads = k_cache.shape[2]

@@ -139,3 +139,42 @@ def test_quantized_engine_generates_on_mesh():
     single = run(None)
     assert sharded == single
     assert all(len(o) == 4 for o in sharded)
+
+
+def test_kv_int8_matches_bf16_cache_within_quant_tolerance():
+    """Cross-config check (code-review r4): int8-cache decode must track
+    the bf16-cache decode within small-int8 tolerance. Both paths share
+    model weights but NOT the cache kernels, so a systematic
+    quantize_kv/dequant bug (e.g. a transposed scale plane) produces
+    order-of-magnitude logits error here rather than cancelling out."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.models import llama
+
+    cfg = llama.config("tiny")
+    cfg8 = dataclasses.replace(cfg, kv_int8=True)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 256)
+
+    cache = llama.init_cache(cfg, 2, 64)
+    logits, cache, cache_len = llama.prefill(params, cfg, toks, cache)
+    cache8 = llama.init_cache(cfg8, 2, 64)
+    logits8, cache8, cache_len8 = llama.prefill(params, cfg8, toks, cache8)
+    # prefill attention reads the in-flight bf16 K/V, not the cache:
+    # identical by construction
+    assert np.allclose(np.asarray(logits), np.asarray(logits8))
+
+    token = jnp.argmax(logits, -1).astype(jnp.int32)
+    for _ in range(4):     # decode reads the (quantized) cache every step
+        ref, cache, cache_len = llama.decode_step(params, cfg, token,
+                                                  cache, cache_len)
+        got, cache8, cache_len8 = llama.decode_step(params, cfg8, token,
+                                                    cache8, cache_len8)
+        ref_np, got_np = np.asarray(ref), np.asarray(got)
+        rel = np.abs(got_np - ref_np).max() / (np.abs(ref_np).max() + 1e-9)
+        assert rel < 0.05, f"int8 KV diverged from bf16 cache: rel={rel}"
+        token = jnp.argmax(ref, -1).astype(jnp.int32)  # same inputs both

@@ -1,0 +1,193 @@
+"""Streaming responses over real sockets: chunked framing, SSE frames,
+mid-stream producer failure, client disconnect, and on_close/producer
+release semantics — the `/generate/stream` serve surface."""
+
+import asyncio
+
+from gofr_tpu.http.response import Stream
+from tests.util import make_app, run, serving
+
+
+async def _read_headers(reader):
+    head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 10.0)
+    return head
+
+
+async def _read_chunks(reader):
+    """Decode chunked transfer encoding until the terminator or EOF.
+    Returns (chunks, saw_terminator)."""
+    chunks = []
+    while True:
+        try:
+            size_line = await asyncio.wait_for(reader.readline(), 10.0)
+        except asyncio.IncompleteReadError:
+            return chunks, False
+        if not size_line:
+            return chunks, False
+        size = int(size_line.strip() or b"0", 16)
+        if size == 0:
+            await reader.readline()
+            return chunks, True
+        data = await asyncio.wait_for(reader.readexactly(size), 10.0)
+        await reader.readline()                      # trailing CRLF
+        chunks.append(data)
+
+
+def test_chunked_stream_and_keepalive():
+    app = make_app()
+
+    async def numbers(ctx):
+        async def gen():
+            for i in range(5):
+                yield f"n{i}"
+        return Stream(gen(), content_type="text/plain")
+
+    app.get("/numbers", numbers)
+    app.get("/after", lambda ctx: "ok")
+
+    async def main():
+        async with serving(app) as port:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(b"GET /numbers HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            head = await _read_headers(reader)
+            assert b"Transfer-Encoding: chunked" in head
+            chunks, clean = await _read_chunks(reader)
+            assert clean and chunks == [b"n0", b"n1", b"n2", b"n3", b"n4"]
+            # clean stream keeps the connection alive for the next request
+            writer.write(b"GET /after HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            head2 = await _read_headers(reader)
+            assert b"200" in head2.split(b"\r\n")[0]
+            writer.close()
+    run(main())
+
+
+def test_sse_framing():
+    app = make_app()
+
+    async def events(ctx):
+        async def gen():
+            yield "alpha"
+            yield b"beta"
+        return Stream(gen(), sse=True)
+
+    app.get("/events", events)
+
+    async def main():
+        async with serving(app) as port:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(b"GET /events HTTP/1.1\r\nHost: x\r\n"
+                         b"Connection: close\r\n\r\n")
+            await writer.drain()
+            head = await _read_headers(reader)
+            assert b"text/event-stream" in head
+            chunks, clean = await _read_chunks(reader)
+            assert clean
+            assert chunks == [b"data: alpha\n\n", b"data: beta\n\n"]
+            writer.close()
+    run(main())
+
+
+def test_midstream_producer_error_truncates_connection():
+    """A producer raising mid-stream must NOT write the terminator (the
+    client sees truncation, not a silently-complete body) and must close
+    the connection."""
+    app = make_app()
+
+    async def broken(ctx):
+        async def gen():
+            yield "first"
+            raise RuntimeError("producer exploded")
+        return Stream(gen())
+
+    app.get("/broken", broken)
+
+    async def main():
+        async with serving(app) as port:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(b"GET /broken HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            await _read_headers(reader)
+            chunks, clean = await _read_chunks(reader)
+            assert chunks == [b"first"]
+            assert not clean                     # no 0\r\n\r\n terminator
+            rest = await asyncio.wait_for(reader.read(64), 10.0)
+            assert rest == b""                   # connection closed
+            writer.close()
+    run(main())
+
+
+def test_on_close_fires_on_clean_completion():
+    app = make_app()
+    closed = []
+
+    async def short(ctx):
+        async def gen():
+            yield "x"
+        return Stream(gen(), on_close=lambda: closed.append("clean"))
+
+    app.get("/short", short)
+
+    async def main():
+        async with serving(app) as port:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(b"GET /short HTTP/1.1\r\nHost: x\r\n"
+                         b"Connection: close\r\n\r\n")
+            await writer.drain()
+            await asyncio.wait_for(reader.read(), 10.0)
+            writer.close()
+            await asyncio.sleep(0.05)
+            assert closed == ["clean"]
+    run(main())
+
+
+def test_client_disconnect_releases_producer():
+    """Client dropping mid-stream must stop the generator (its finally
+    runs) and fire on_close — an abandoned /generate must free its
+    engine slot instead of decoding the rest of the budget."""
+    app = make_app()
+    state = {"produced": 0, "finalized": False, "on_close": 0}
+    proceed = asyncio.Event()
+
+    async def endless(ctx):
+        async def gen():
+            try:
+                while True:
+                    state["produced"] += 1
+                    yield f"tok{state['produced']}"
+                    if state["produced"] == 3:
+                        proceed.set()       # client will now disconnect
+                    await asyncio.sleep(0.02)
+            finally:
+                state["finalized"] = True
+
+        def on_close():
+            state["on_close"] += 1
+
+        return Stream(gen(), on_close=on_close)
+
+    app.get("/endless", endless)
+
+    async def main():
+        async with serving(app) as port:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(b"GET /endless HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            await _read_headers(reader)
+            await asyncio.wait_for(proceed.wait(), 10.0)
+            writer.close()                      # client walks away
+            for _ in range(100):                # ≤ 2s for the server side
+                if state["finalized"] and state["on_close"]:
+                    break
+                await asyncio.sleep(0.02)
+            assert state["finalized"], "generator finally never ran"
+            assert state["on_close"] == 1
+            # production stopped promptly (not the whole "budget")
+            assert state["produced"] < 20
+    run(main())
