@@ -94,6 +94,12 @@ PRESETS: Dict[str, LlamaConfig] = {
     "small": LlamaConfig(vocab_size=32000, dim=1024, n_layers=8, n_heads=16,
                          n_kv_heads=16, ffn_dim=2816, max_seq_len=2048),
     "7b": LlamaConfig(),  # Llama-2-7B geometry
+    # Llama-3-8B geometry: GQA 32:8 (the engine's decode attention and
+    # cache specs handle grouped KV heads natively), 128K-token-family
+    # vocab, rope theta 500k
+    "llama3-8b": LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=8, ffn_dim=14336,
+                             max_seq_len=8192, rope_theta=500000.0),
 }
 
 

@@ -133,3 +133,27 @@ def test_llama_7b_config_geometry():
                             jax.random.PRNGKey(0))
     count = sum(np.prod(l.shape) for l in jax.tree.leaves(params))
     assert 6.5e9 < count < 7.1e9, count  # Llama-2-7B ≈ 6.74B
+
+
+def test_llama3_geometry_gqa_decode():
+    """The llama3-8b preset's GQA shape (32 q-heads over 8 kv-heads)
+    must decode correctly; exercised at tiny scale with the same 4:1
+    grouping so cache layout + grouped attention paths run."""
+    import dataclasses
+
+    cfg = llama.config("llama3-8b")
+    assert cfg.n_heads // cfg.n_kv_heads == 4
+    assert cfg.rope_theta == 500000.0
+
+    mini = dataclasses.replace(cfg, vocab_size=64, dim=64, n_layers=2,
+                               n_heads=8, n_kv_heads=2, ffn_dim=128,
+                               max_seq_len=64)
+    params = llama.init(mini, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 64)
+    out = np.asarray(llama.generate(params, mini, toks, 8))
+    assert out.shape == (2, 8)
+    # engine-style path: prefill + cached decode equals fused generate
+    cache = llama.init_cache(mini, 2, 32)
+    logits, cache, cache_len = llama.prefill(params, mini, toks, cache)
+    step_tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    assert int(step_tok[0]) == int(out[0, 0])

@@ -191,3 +191,55 @@ def test_client_disconnect_releases_producer():
             # production stopped promptly (not the whole "budget")
             assert state["produced"] < 20
     run(main())
+
+
+def test_stream_observers_record_duration_and_status():
+    """Middleware can't time a stream from the dispatch tuple (the body
+    hasn't been produced yet): the logging/metrics middlewares observe
+    via StreamBody.on_complete. A clean stream must land in
+    app_http_response as a 200 with true duration; a mid-stream producer
+    failure must record as 500."""
+    app = make_app()
+
+    async def good(ctx):
+        async def gen():
+            yield "a"
+            await asyncio.sleep(0.15)   # measurable stream duration
+            yield "b"
+        return Stream(gen())
+
+    async def bad(ctx):
+        async def gen():
+            yield "a"
+            raise RuntimeError("mid-stream")
+        return Stream(gen())
+
+    app.get("/good", good)
+    app.get("/bad", bad)
+    metrics = app.container.metrics
+
+    async def main():
+        async with serving(app) as port:
+            for path in ("/good", "/bad"):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                             "Connection: close\r\n\r\n".encode())
+                await writer.drain()
+                await asyncio.wait_for(reader.read(), 10.0)
+                writer.close()
+            await asyncio.sleep(0.05)
+            ok_count = metrics.value("app_http_response", method="GET",
+                                     path="/good", status="200")
+            bad_count = metrics.value("app_http_response", method="GET",
+                                      path="/bad", status="500")
+            assert ok_count == 1.0
+            assert bad_count == 1.0
+            # duration reflects the real stream (≥ the 0.15s sleep), not
+            # the near-zero dispatch time
+            series = metrics.snapshot()["app_http_response"].series
+            ok_sum = next(
+                state["sum"] for key, state in series.items()
+                if dict(key).get("path") == "/good")
+            assert ok_sum >= 0.15
+    run(main())
