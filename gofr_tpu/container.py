@@ -129,6 +129,8 @@ class Container:
         metrics.new_gauge("app_tpu_hbm_bytes_in_use", "HBM bytes in use per device")
         metrics.new_gauge("app_tpu_device_up", "per-device liveness 0/1")
         metrics.new_counter("app_tpu_requests_total", "TPU predict requests")
+        metrics.new_gauge("app_tpu_attention_window",
+                          "decode attention window rung (fill-bounded)")
 
     # -- outbound services (container.go:150-152) ---------------------------
     def add_http_service(self, name: str, service: Any) -> None:

@@ -563,6 +563,8 @@ class GenerationEngine:
                 "decode_steps": self._steps,
                 "prefill_batches": self._prefills,
                 "max_len": self.max_len,
+                "window_ladder": [w or self.max_len
+                                  for w in self._window_ladder],
                 "mesh": dict(self.mesh.shape) if self.mesh else None}
 
     def health_check(self) -> Dict[str, Any]:
@@ -874,6 +876,9 @@ class GenerationEngine:
         if self.metrics is not None:
             self.metrics.record_histogram(
                 "app_tpu_batch_size", float(len(snapshot)), model="generate")
+            self.metrics.set_gauge(
+                "app_tpu_attention_window",
+                float(window or self.max_len), model="generate")
         return tokens_dev, snapshot
 
     def _push_tokens(self, slot_idx: int, gen: int,

@@ -156,3 +156,35 @@ def test_env_overlay_precedence(tmp_path, monkeypatch):
     assert config.get("A") == "base"        # base survives
     assert config.get("B") == "prod"        # overlay wins over base
     assert config.get("C") == "process"     # process env wins over all
+
+
+def test_window_gauge_and_stats_exposed():
+    """The attention-window rung is observable: stats() lists the ladder
+    and a tick sets the app_tpu_attention_window gauge."""
+    import asyncio
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    # no manual registration: the framework catalog (container.py
+    # register_framework_metrics) must provide the gauge
+    container = new_mock_container()
+    engine = GenerationEngine(cfg, params, max_slots=2, max_len=256,
+                              prompt_buckets=(8,),
+                              logger=container.logger,
+                              metrics=container.metrics)
+    assert engine.stats()["window_ladder"] == [128, 256]
+
+    async def main():
+        await engine.start()
+        try:
+            await asyncio.wait_for(
+                engine.generate([1, 2, 3], max_new_tokens=4), 60.0)
+            assert container.metrics.value(
+                "app_tpu_attention_window", model="generate") == 128.0
+        finally:
+            await engine.stop()
+    asyncio.run(main())
