@@ -69,6 +69,11 @@ class App:
         self._grpc_services: List[tuple] = []
         self._cli_commands: List[Any] = []
         self._request_timeout = self.config.get_float("REQUEST_TIMEOUT", 0.0)
+        # How long stop() lets in-flight responses (incl. active SSE
+        # generation streams) finish before force-closing their
+        # connections. Operators serving long generations raise this.
+        self._shutdown_grace = self.config.get_float(
+            "SHUTDOWN_GRACE_PERIOD", 5.0)
         self.http_port = self.config.get_int("HTTP_PORT", DEFAULT_HTTP_PORT)
         self.grpc_port = self.config.get_int("GRPC_PORT", DEFAULT_GRPC_PORT)
         self.metrics_port = self.config.get_int("METRICS_PORT", DEFAULT_METRICS_PORT)
@@ -377,7 +382,7 @@ class App:
             task.cancel()
         self._tasks.clear()
         if self._http_server is not None:
-            await self._http_server.shutdown()
+            await self._http_server.shutdown(drain_grace=self._shutdown_grace)
         if self._metrics_server is not None:
             await self._metrics_server.shutdown()
         if self._grpc_server is not None:

@@ -86,12 +86,15 @@ def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
 
     int8 KV cache (ops/quant.quantize_kv): pass ``k_cache``/``v_cache`` as
     int8 with ``k_scale``/``v_scale`` (B, Tmax, Hkv) per-vector scales.
-    The dequant is WRITTEN to fuse (int8 upcast into the einsum, scale
-    folded in afterwards as a rank-1 broadcast), but MEASURED on v5e the
-    convert does not stay fused — XLA materializes a converted copy and
-    the int8 path decodes ~12% slower than bf16 (post-mortem:
-    models/llama.py LlamaConfig.kv_int8). The int8 cache remains the
-    HBM-*capacity* lever; a fused Pallas kernel is the known speed fix.
+    K dequant folds the scale into the f32 scores after the einsum; V
+    dequant folds ``v_scale`` into the f32 probs *before* an f32 cache
+    einsum (ADVICE r4: scaling bf16 probs stacked mantissa loss on the
+    int8 error — this path is the capacity lever, so it buys precision
+    with bandwidth). Either lowering leaves the int8→wide convert
+    unfused on v5e — XLA materializes a converted cache copy, which is
+    why int8-KV MEASURED ~12% slower than bf16 under the original bf16
+    lowering and remains default-off (post-mortem: models/llama.py
+    LlamaConfig.kv_int8); a fused Pallas kernel is the known speed fix.
     """
     batch, _, q_heads, head_dim = q.shape
     kv_heads = k_cache.shape[2]
@@ -113,9 +116,16 @@ def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
     probs = probs / probs.sum(axis=-1, keepdims=True)
     probs_cache = probs[..., :-1]
     if v_scale is not None:
+        # int8 path: keep the probs * v_scale product in f32 through the
+        # cache V einsum — casting the scaled probs to bf16 first stacks
+        # bf16 mantissa loss on top of the int8 quantization error, and
+        # this path is the capacity (not speed) lever anyway.
         probs_cache = probs_cache * v_scale.transpose(0, 2, 1)[:, :, None, :]
-    out = jnp.einsum("bkgt,btkd->bkgd", probs_cache.astype(q.dtype),
-                     v_cache.astype(q.dtype))
+        out = jnp.einsum("bkgt,btkd->bkgd", probs_cache,
+                         v_cache.astype(jnp.float32)).astype(q.dtype)
+    else:
+        out = jnp.einsum("bkgt,btkd->bkgd", probs_cache.astype(q.dtype),
+                         v_cache.astype(q.dtype))
     out = out + jnp.einsum("bkg,bkd->bkgd", probs[..., -1].astype(q.dtype),
                            v_new)
     return out.reshape(batch, 1, q_heads, head_dim)

@@ -51,7 +51,7 @@ from __future__ import annotations
 
 import asyncio
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -393,6 +393,24 @@ class GenerationEngine:
             self._decode_fns[(k_steps, sampled, window)] = fn
         return fn
 
+    def _startup_window_rungs(self, ks: List[int]) -> List[Optional[int]]:
+        """Window rungs reachable right after startup: every rung up to and
+        including the one covering the largest prompt bucket + the largest
+        fused-step count (a fresh prompt can land its first tick on any of
+        these). Deeper rungs compile lazily off-loop as generations grow
+        past them."""
+        if len(self._window_ladder) == 1:
+            return list(self._window_ladder)
+        max_k = max(ks) if ks else 1
+        deepest = max(self.prompt_buckets) if self.prompt_buckets else 1
+        reach = self._pick_window([deepest], max_k)
+        rungs: List[Optional[int]] = []
+        for w in self._window_ladder:
+            rungs.append(w)
+            if w == reach:
+                break
+        return rungs
+
     def _pick_window(self, fills: List[int], k: int) -> Optional[int]:
         """Smallest window rung covering every participating slot's fill
         plus the k fused steps (None = full cache)."""
@@ -405,7 +423,8 @@ class GenerationEngine:
     async def warmup(self, prompt_counts: Tuple[int, ...] = (1,),
                      ks: Optional[Tuple[int, ...]] = None,
                      sampling: bool = False,
-                     windows: Optional[Tuple[Optional[int], ...]] = None
+                     windows: Union[Tuple[Optional[int], ...], str,
+                                    None] = None
                      ) -> None:
         """Pre-compile the decode ladder and prefill/insert executables so
         the serving path never traces (executor.warmup analog). ``ks``
@@ -413,6 +432,22 @@ class GenerationEngine:
         ladder); an unwarmed rung still compiles lazily off-loop if the
         scheduler ever picks it. ``sampling=True`` additionally warms the
         sampled decode variants (temperature/top-k/top-p requests).
+
+        ``windows`` selects which attention-window rungs to warm:
+
+        - ``None`` (default): only the rungs reachable at startup — every
+          rung up to and including the one covering the largest prompt
+          bucket (a fresh prompt's first tick can land on any of those).
+          A long generation ascends past these and compiles the next rung
+          lazily off-loop; the alternative (warming the full k x window
+          cross-product) multiplies startup compiles by the full ladder
+          depth (7x at max_len=8192), which is the wrong default at 7B
+          scale.
+        - ``"all"``: the full ladder (opt-in full-matrix warmup).
+        - an explicit tuple: exactly those rungs. Every entry must be a
+          ladder rung (``engine_stats()["window_ladder"]`` lists them,
+          with ``None`` spelled as max_len) — a silent mismatch would warm
+          nothing and push compilation onto the first serving tick.
 
         Must run before ``start()``: warmup mutates cache/cache_len/
         last_token through donated-buffer executables, and racing the
@@ -423,10 +458,38 @@ class GenerationEngine:
                 "device state outside the engine loop")
         jnp = self._jnp
         loop = asyncio.get_running_loop()
-        rungs = self._k_ladder if ks is None \
-            else [k for k in self._k_ladder if k in ks]
-        window_rungs = self._window_ladder if windows is None \
-            else [w for w in self._window_ladder if w in windows]
+        if ks is None:
+            rungs = list(self._k_ladder)
+        else:
+            unknown = [k for k in ks if k not in self._k_ladder]
+            if unknown or not ks:
+                raise ValueError(
+                    f"warmup ks={unknown or ks} are not k-ladder rungs "
+                    f"{self._k_ladder}; nothing would be warmed for them")
+            rungs = [k for k in self._k_ladder if k in ks]
+        if windows is None:
+            window_rungs = self._startup_window_rungs(rungs)
+        elif isinstance(windows, str):
+            if windows != "all":
+                raise ValueError(
+                    f"warmup windows={windows!r}: the only string sentinel "
+                    f"is 'all' (full-matrix warmup)")
+            window_rungs = list(self._window_ladder)
+        else:
+            unknown = [w for w in windows if w not in self._window_ladder]
+            if unknown or not windows:
+                raise ValueError(
+                    f"warmup windows={unknown or windows} are not "
+                    f"window-ladder rungs {self._window_ladder}; nothing "
+                    f"would be warmed for them and the first serving tick "
+                    f"would compile on the hot path")
+            window_rungs = [w for w in self._window_ladder if w in windows]
+        if self.logger is not None:
+            n = len(rungs) * len(window_rungs) * (2 if sampling else 1)
+            self.logger.info(
+                "engine warmup: compiling %d decode executables "
+                "(ks=%s windows=%s sampling=%s)",
+                n, rungs, window_rungs, sampling)
 
         def compile_all():
             active = jnp.zeros((self.max_slots,), bool)

@@ -24,6 +24,25 @@ def test_hello_roundtrip():
     run(main())
 
 
+def test_shutdown_grace_configurable():
+    """ADVICE r4 low: operators serving long SSE generations must be able
+    to extend the drain window; SHUTDOWN_GRACE_PERIOD flows app → server."""
+    async def main():
+        app = make_app({"SHUTDOWN_GRACE_PERIOD": "42.5"})
+        assert app._shutdown_grace == 42.5
+
+        grace_seen = []
+        async with serving(app):
+            orig = app._http_server.shutdown
+
+            async def spy(drain_grace=5.0):
+                grace_seen.append(drain_grace)
+                await orig(drain_grace=drain_grace)
+            app._http_server.shutdown = spy
+        assert grace_seen == [42.5]
+    run(main())
+
+
 def test_post_binding_and_status():
     async def main():
         app = make_app()

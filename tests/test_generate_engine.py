@@ -197,6 +197,47 @@ def test_engine_warmup_precompiles(setup):
     asyncio.run(main())
 
 
+def test_warmup_defaults_to_startup_window_subset(setup):
+    """ADVICE r4 medium: default warmup must not compile the full
+    k x window cross-product — only the startup-reachable rungs; the
+    full matrix is opt-in via windows="all"."""
+    cfg, params = setup
+
+    async def main():
+        engine = _make_engine(cfg, params, max_len=512,
+                              prompt_buckets=(8, 16), steps_per_tick=4)
+        assert engine._window_ladder == [128, 256, None]
+        await engine.warmup(prompt_counts=(1,))
+        warmed = {w for (_, _, w) in engine._decode_fns}
+        assert warmed == {128}, warmed   # bucket 16 + k 4 fits rung 128
+
+        full = _make_engine(cfg, params, max_len=512,
+                            prompt_buckets=(8, 16), steps_per_tick=4)
+        await full.warmup(prompt_counts=(1,), windows="all")
+        assert {w for (_, _, w) in full._decode_fns} == {128, 256, None}
+    asyncio.run(main())
+
+
+def test_warmup_rejects_unknown_rungs(setup):
+    """ADVICE r4 low: a windows/ks filter that matches nothing must raise,
+    not silently warm zero executables."""
+    cfg, params = setup
+
+    async def main():
+        engine = _make_engine(cfg, params, max_len=512, steps_per_tick=4)
+        with pytest.raises(ValueError, match="window-ladder"):
+            await engine.warmup(windows=(999,))
+        with pytest.raises(ValueError, match="k-ladder"):
+            await engine.warmup(ks=(3,))
+        with pytest.raises(ValueError, match="window-ladder"):
+            await engine.warmup(windows=())     # empty = warms nothing
+        with pytest.raises(ValueError, match="k-ladder"):
+            await engine.warmup(ks=())
+        with pytest.raises(ValueError, match="sentinel"):
+            await engine.warmup(windows="ALL")
+    asyncio.run(main())
+
+
 def test_warmup_after_start_rejected(setup):
     """warmup() mutates donated device state; racing the engine loop would
     dispatch against invalidated buffers (ADVICE r2)."""
