@@ -6,8 +6,10 @@ on the classify path. This bench measures all of it honestly:
 1. **Device-resident steady state** — the compiled classify step at the
    serving batch (MXU utilisation ceiling), with MFU computed from XLA's
    own cost analysis against the chip's bf16 peak.
-2. **Operating point** — the largest batch whose device latency fits a
-   p99 < 10 ms budget, and the per-chip req/s at that point.
+2. **Operating point** — a device-attributable sweep over the bucket
+   ladder (8..256, paired-slope timing per bucket); the operating point is
+   the largest bucket fitting the p99 < 10 ms budget at ≥1000 req/s, the
+   full sweep is reported so the knee is visible.
 3. **Closed-loop HTTP** — real requests through router → middleware →
    handler → dynamic batcher → executor (the path BASELINE.md names),
    reporting measured p50/p99 for /hello (framework overhead, config 1)
@@ -17,7 +19,10 @@ on the classify path. This bench measures all of it honestly:
    its TPU through the axon relay (~35 MB/s H2D, ~500x below a real v5e
    host's PCIe), so the relay-included number is a tunnel artifact,
    reported for transparency as ``value_with_relay_h2d``.
-5. **Llama continuous-batching decode** — aggregate tok/s through the
+5. **BERT gRPC embeddings** (BASELINE config 3) — device-side batching
+   gain curve + closed-loop gRPC unary at concurrency 1 vs 32 (the
+   dynamic batcher's coalescing gain) + server-streaming TTFB.
+6. **Llama continuous-batching decode** — aggregate tok/s through the
    generation engine, post-warmup (the executable ladder is precompiled;
    round 2 accidentally timed four TPU compiles).
 
@@ -44,6 +49,90 @@ PEAK_BF16 = {
 }
 
 
+# Round-over-round annotations (VERDICT r4 weak #2: headline deltas >10%
+# shipped without a word). Keyed by ledger metric name; the ledger attaches
+# the note whenever |delta| > 10% — and flags UNANNOTATED if a metric moved
+# that much with no entry here, so a silent regression can't ship again.
+REGRESSION_NOTES = {
+    "http_hello_req_s": (
+        "CPU-bound on this 1-core bench container: single-window readings "
+        "swing ±30% with host load. r5 A/B-ran the r3 server code on the "
+        "same host inside the same band (5.3-7.3k), so the r4 'drop' was "
+        "harness variance, not code; now median-of-3 windows"),
+    "http_classify_req_s": (
+        "full-path number is relay-H2D-bound (~9-35 MB/s day-to-day); "
+        "compare against the same-run `relay` block, not across rounds"),
+    "resnet50_classify_req_s": (
+        "relay-included headline; the stable cross-round number is "
+        "device_only_req_per_s (paired-slope, dispatch floor cancelled)"),
+    "llama_small_decode_tok_s": (
+        "engine aggregate includes host-side dispatch through the relay; "
+        "relay round-trip p50 varied 18-128 ms across rounds"),
+    "llama7b_decode_tok_s": (
+        "engine aggregate through the relay; device_only_tok_s is the "
+        "hardware-attributable metric. r5 moved the operating point to "
+        "48 slots x K=32 @ max_len 256 (sweep in _llama7b_int8_bench)"),
+    "llama7b_device_only_tok_s": (
+        "r5 operating-point move (48 slots x K=32, full-window @256): "
+        "K=32 amortizes per-step overhead, 3x slots amortize the weight "
+        "stream — see llama7b_int8.note and the function docstring's "
+        "sweep post-mortems"),
+}
+
+_LEDGER_PATHS = {
+    "resnet50_classify_req_s": ("value",),
+    "device_only_req_per_s": ("device_only_req_per_s",),
+    "mfu": ("mfu",),
+    "http_hello_req_s": ("http_hello", "req_per_s"),
+    "http_classify_req_s": ("http_classify", "req_per_s"),
+    "bert_grpc_emb_s_batched": ("bert", "grpc_emb_per_s_concurrency_32"),
+    "llama_small_decode_tok_s": ("llama_small_decode_tok_s",),
+    "llama7b_decode_tok_s": ("llama7b_int8", "decode_tok_s"),
+    "llama7b_device_only_tok_s": ("llama7b_int8", "device_only_tok_s"),
+}
+
+
+def _dig(tree, path):
+    for key in path:
+        if not isinstance(tree, dict) or key not in tree:
+            return None
+        tree = tree[key]
+    return tree if isinstance(tree, (int, float)) else None
+
+
+def _regression_ledger(current: dict) -> dict:
+    """prev/delta_pct per headline metric vs the newest BENCH_r*.json
+    artifact, with a mandatory note on any |delta| > 10%."""
+    import glob
+    import os
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    artifacts = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    prev = {}
+    if artifacts:
+        try:
+            with open(artifacts[-1]) as fh:
+                prev = json.load(fh).get("parsed") or {}
+        except (OSError, ValueError):
+            prev = {}
+    ledger = {}
+    for name, path in _LEDGER_PATHS.items():
+        cur_v, prev_v = _dig(current, path), _dig(prev, path)
+        if cur_v is None:
+            continue
+        entry = {"value": cur_v}
+        if prev_v:
+            delta = (cur_v - prev_v) / prev_v * 100.0
+            entry["prev"] = prev_v
+            entry["delta_pct"] = round(delta, 1)
+            if abs(delta) > 10.0:
+                entry["note"] = REGRESSION_NOTES.get(
+                    name, "UNANNOTATED move >10% — investigate before "
+                          "trusting this round")
+        ledger[name] = entry
+    return ledger
+
+
 def main() -> None:
     import jax
 
@@ -53,11 +142,12 @@ def main() -> None:
     relay = _relay_floor_bench()
     resnet_stats = _resnet_bench(on_tpu)
     http_stats = _http_bench(on_tpu)
+    bert_stats = _bert_grpc_bench(on_tpu)
     llama_small = _llama_decode_bench(on_tpu)
     llama7b = _llama7b_int8_bench(on_tpu)
 
     req_per_s = resnet_stats.pop("req_per_s")
-    print(json.dumps({
+    out = {
         "metric": "resnet50_classify_throughput_per_chip",
         "value": round(req_per_s, 1),
         "unit": "req/s",
@@ -66,10 +156,13 @@ def main() -> None:
         "relay": relay,
         **resnet_stats,
         **http_stats,
+        "bert": bert_stats,
         "llama_small_decode_tok_s": llama_small.pop("tok_s_best"),
         "llama_small_decode": llama_small,
         "llama7b_int8": llama7b,
-    }))
+    }
+    out["ledger"] = _regression_ledger(out)
+    print(json.dumps(out))
 
 
 def _relay_floor_bench() -> dict:
@@ -114,6 +207,34 @@ def _relay_floor_bench() -> dict:
         "h2d_mb_s": round(len(blob) / 2**20 / min(h2d), 1),
         "d2h_mb_s": round(len(blob) / 2**20 / min(d2h), 1),
     }
+
+
+def _paired_slope_latency(fn, *args, reps: int = 5):
+    """Device-attributable latency of one ``fn(*args)`` call via paired
+    slopes: chains of 4 and 24 back-to-back dispatches, each ended by a
+    real fetch (block_until_ready alone does not barrier through the
+    relay), so the relay's fixed per-call cost cancels in (t24-t4)/20.
+    Returns (latency_seconds | None, slope_spread | None); None latency
+    means relay noise swamped the signal (non-positive slope)."""
+    import jax
+
+    def win(n):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(n)]
+        np.asarray(outs[-1])
+        jax.block_until_ready(outs)
+        return time.perf_counter() - t0
+
+    win(4)  # settle
+    slopes = []
+    for _ in range(reps):
+        t4 = win(4)
+        t24 = win(24)
+        slopes.append((t24 - t4) / 20)
+    lat = float(np.median(slopes))
+    if lat <= 0:
+        return None, None
+    return lat, (max(slopes) - min(slopes)) / lat
 
 
 def _percentiles(latencies):
@@ -191,24 +312,46 @@ def _resnet_bench(on_tpu: bool) -> dict:
     mfu = (device_req_s * flops_per_image / peak) \
         if (peak and device_req_s) else None
 
-    # operating point: largest batch whose device latency fits the p99
-    # budget (batch latency + one queued batch of slack < 10 ms). If even
-    # the smallest batch misses the budget (e.g. per-call dispatch floor
-    # through the relay), the point is still reported with
-    # fits_budget=false — never implied to satisfy the target.
-    op_batch, op_req_s, op_latency_ms, op_fits = None, None, None, False
-    for b in ((32, 64, 128) if on_tpu else (4, 8)):
+    # operating point (VERDICT r4 #1): sweep the bucket ladder and time
+    # each bucket's DEVICE-attributable latency via paired slopes — chains
+    # of 4 and 24 back-to-back executes, each ended by a real fetch, so
+    # the relay's fixed per-call cost cancels in (t24-t4)/20. The point is
+    # the largest bucket whose closed-loop p99 proxy (service + one queued
+    # batch of slack = 2x latency) fits the 10 ms budget; fits_budget is
+    # judged on device-attributable latency because that is what a real
+    # TPU host (µs dispatch, PCIe H2D) serves — the relay floor is
+    # reported alongside in the top-level `relay` block, never silently
+    # folded in.
+    sweep = []
+    op = None
+    for b in ((8, 16, 32, 64, 128, 256) if on_tpu else (4, 8)):
         xb = jax.device_put(jnp.asarray(u8_host[:1]).repeat(b, axis=0))
-        jax.block_until_ready(step(params, xb))
-        lat = min(timed_window(step, xb, max(4, iters // 2))
-                  for _ in range(2))
-        # closed-loop p99 ≈ service + one full wait in queue
-        fits = 2.0 * lat * 1e3 < TARGET_P99_MS
-        if fits or op_batch is None:
-            op_batch, op_req_s = b, b / lat
-            op_latency_ms, op_fits = lat * 1e3, fits
-        if not fits:
-            break
+        comp_b = step.lower(params, xb).compile()
+        jax.block_until_ready(comp_b(params, xb))
+        lat, spread = _paired_slope_latency(comp_b, params, xb)
+        if lat is None:
+            sweep.append({"batch": b, "device_latency_ms": None,
+                          "note": "slope <= 0: relay noise swamped signal"})
+            continue
+        point = {"batch": b,
+                 "device_latency_ms": round(lat * 1e3, 2),
+                 "req_per_s": round(b / lat, 1),
+                 "p99_proxy_ms": round(2.0 * lat * 1e3, 2),
+                 "slope_spread": round(spread, 2),
+                 "fits_budget": 2.0 * lat * 1e3 < TARGET_P99_MS}
+        sweep.append(point)
+        if point["fits_budget"] and point["req_per_s"] >= TARGET_REQ_S \
+                and (op is None or point["req_per_s"] > op["req_per_s"]):
+            op = point
+    if op is None:      # nothing fits: report the knee, honestly failing
+        candidates = [p for p in sweep if p.get("device_latency_ms")]
+        op = min(candidates,
+                 key=lambda p: p["p99_proxy_ms"]) if candidates else {
+                     "batch": None, "fits_budget": False}
+    op_point = {**op, "p99_budget_ms": TARGET_P99_MS,
+                "target_req_s": TARGET_REQ_S,
+                "basis": "device-attributable latency (paired slopes); "
+                         "relay per-call floor reported in `relay`"}
 
     # pipelined host-input: double-buffer the H2D — start batch N+1's
     # device_put before syncing batch N's output, so transfer rides under
@@ -240,13 +383,8 @@ def _resnet_bench(on_tpu: bool) -> dict:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_image": round(flops_per_image / 1e9, 2),
         "device_kind": device_kind,
-        "operating_point": {
-            "batch": op_batch,
-            "req_per_s": round(op_req_s, 1),
-            "batch_latency_ms": round(op_latency_ms, 2),
-            "p99_budget_ms": TARGET_P99_MS,
-            "fits_budget": op_fits,
-        },
+        "operating_point": op_point,
+        "bucket_sweep": sweep,
         "value_with_relay_h2d": round(batch / per_batch_relay, 1),
     }
 
@@ -352,19 +490,32 @@ def _http_bench(on_tpu: bool) -> dict:
         app.container.tpu.warmup(
             "resnet50", np.ones(shape, np.uint8))  # compile all buckets
         port = app._http_server.bound_port
-        hello_req_s, hello_lat = await loop.run_in_executor(
-            None, load_in_thread, port, "/hello", b"", "GET", 32, seconds)
+        # hello is CPU-bound on this 1-core container, so a single window
+        # swings ±30% with host load (r4 shipped 5495 vs r3's 9090 from
+        # exactly this; an A/B of the r3 server code on the same host
+        # measured inside the same band). Run 3 windows, report median +
+        # the spread so readers can judge the noise.
+        hello_rounds = []
+        hello_lat = []
+        for _ in range(3):
+            r, lats = await loop.run_in_executor(
+                None, load_in_thread, port, "/hello", b"", "GET", 32,
+                seconds)
+            hello_rounds.append(r)
+            hello_lat.extend(lats)
         cls_req_s, cls_lat = await loop.run_in_executor(
             None, load_in_thread, port, "/classify", image, "POST", 16,
             seconds)
         await app.stop()
-        return hello_req_s, hello_lat, cls_req_s, cls_lat
+        return hello_rounds, hello_lat, cls_req_s, cls_lat
 
-    hello_req_s, hello_lat, cls_req_s, cls_lat = asyncio.run(run_loads())
+    hello_rounds, hello_lat, cls_req_s, cls_lat = asyncio.run(run_loads())
     hello_p50, hello_p99 = _percentiles(hello_lat)
     cls_p50, cls_p99 = _percentiles(cls_lat)
     return {
-        "http_hello": {"req_per_s": round(hello_req_s, 1),
+        "http_hello": {"req_per_s": round(float(np.median(hello_rounds)), 1),
+                       "rounds_req_per_s": [round(r, 1)
+                                            for r in hello_rounds],
                        "p50_ms": hello_p50, "p99_ms": hello_p99,
                        "clients": 32},
         "http_classify": {"req_per_s": round(cls_req_s, 1),
@@ -373,6 +524,155 @@ def _http_bench(on_tpu: bool) -> dict:
                           "note": "full path incl. relay H2D"},
         "p50_ms": cls_p50,
         "p99_ms": cls_p99,
+    }
+
+
+def _bert_grpc_bench(on_tpu: bool) -> dict:
+    """BASELINE.md config 3: gRPC streaming BERT-base embeddings with
+    dynamic batching (VERDICT r4 #3 — the one config with no perf number).
+
+    Three views, because the *batching gain curve* is the point:
+    1. Device-side ceiling — the compiled embed step at batch 1/8/32 via
+       paired slopes: what one chip sustains per batch shape.
+    2. Full gRPC unary path at concurrency 1 vs 32 — through grpc.aio,
+       dynamic JSON codec, context middleware, and the dynamic batcher;
+       the concurrency-32 number shows the batcher coalescing real
+       concurrent RPCs (each call still pays the relay dispatch floor,
+       which amortizes across the coalesced batch).
+    3. Server-streaming TTFB — `/gofr.Embeddings/embedStream` emits one
+       embedding message per sentence; time to the first message.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.app import App
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import bert
+
+    max_len = 64
+    cfg = bert.config("base" if on_tpu else "tiny", max_len=max_len)
+    params = jax.device_put(bert.init(cfg, jax.random.PRNGKey(0)))
+
+    def embed_step(p, inputs):
+        ids, mask = inputs
+        return bert.apply(p, cfg, ids, mask)["mean"]
+
+    # 1. device-side batching gain curve (paired slopes, relay cancelled)
+    step = jax.jit(embed_step)
+    gain = []
+    for b in ((1, 8, 32) if on_tpu else (1, 4)):
+        ids = jax.device_put(jnp.ones((b, max_len), jnp.int32))
+        mask = jax.device_put(jnp.ones((b, max_len), jnp.int32))
+        compiled = step.lower(params, (ids, mask)).compile()
+        np.asarray(compiled(params, (ids, mask)))
+        lat, _spread = _paired_slope_latency(compiled, params, (ids, mask))
+        gain.append({"batch": b,
+                     "device_latency_ms": round(lat * 1e3, 3)
+                     if lat else None,
+                     "emb_per_s": round(b / lat, 1) if lat else None})
+
+    # 2 + 3. the real gRPC path
+    container = new_mock_container({"TPU_ENABLED": "true",
+                                    "TPU_MAX_BATCH": "32",
+                                    "TPU_BATCH_DELAY_MS": "2.0"})
+    app = App(config=container.config, container=container)
+    app.http_port = 0
+    app.metrics_port = 0
+    app.grpc_port = 0
+    app.add_model("bert", embed_step, params=params, buckets=(1, 4, 16, 32))
+
+    async def embed(ctx):
+        data = ctx.bind()
+        ids = np.zeros((max_len,), np.int32)
+        mask = np.zeros((max_len,), np.int32)
+        tokens = data["token_ids"][:max_len]
+        ids[:len(tokens)] = tokens
+        mask[:len(tokens)] = 1
+        out = await ctx.predict("bert", (ids, mask))
+        return {"dim": len(out)}     # skip float serialization in the loop
+
+    async def embed_stream(ctx):
+        data = ctx.bind()
+        for sentence in data["batch"]:
+            ids = np.zeros((max_len,), np.int32)
+            mask = np.zeros((max_len,), np.int32)
+            tokens = sentence[:max_len]
+            ids[:len(tokens)] = tokens
+            mask[:len(tokens)] = 1
+            out = await ctx.predict("bert", (ids, mask))
+            yield {"embedding": [round(float(v), 4) for v in out[:8]]}
+
+    app.register_grpc_unary("Embeddings", "embed", embed)
+    app.register_grpc_stream("Embeddings", "embedStream", embed_stream)
+
+    seconds = 4.0 if on_tpu else 1.5
+    payload = json.dumps({"token_ids": list(range(16))}).encode()
+
+    def grpc_load(port, concurrency, seconds):
+        """Closed-loop unary load from a worker thread's own event loop."""
+        import grpc
+
+        async def go():
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                method = ch.unary_unary("/gofr.Embeddings/embed")
+                warm_until = time.perf_counter() + seconds * 0.3
+                stop_at = warm_until + seconds
+                counted = [0]
+
+                async def one():
+                    while time.perf_counter() < stop_at:
+                        await method(payload)
+                        if time.perf_counter() >= warm_until:
+                            counted[0] += 1
+                await asyncio.gather(*[one() for _ in range(concurrency)])
+                rate = counted[0] / seconds
+            await asyncio.sleep(0.1)   # let grpc.aio's poller quiesce
+            return rate
+        return asyncio.run(go())
+
+    def grpc_ttfb(port, samples=8):
+        import grpc
+
+        async def go():
+            body = json.dumps({"batch": [list(range(12))] * 4}).encode()
+            ttfbs = []
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                method = ch.unary_stream("/gofr.Embeddings/embedStream")
+                for _ in range(samples):
+                    t0 = time.perf_counter()
+                    call = method(body)
+                    async for _ in call:
+                        ttfbs.append(time.perf_counter() - t0)
+                        break
+                    call.cancel()
+            await asyncio.sleep(0.1)   # let grpc.aio's poller quiesce
+            return ttfbs
+        return asyncio.run(go())
+
+    async def run_loads():
+        await app.start()
+        loop = asyncio.get_running_loop()
+        container.tpu.warmup("bert", (np.ones((max_len,), np.int32),
+                                      np.ones((max_len,), np.int32)))
+        port = app._grpc_server.bound_port
+        seq = await loop.run_in_executor(None, grpc_load, port, 1, seconds)
+        batched = await loop.run_in_executor(
+            None, grpc_load, port, 32, seconds)
+        ttfbs = await loop.run_in_executor(None, grpc_ttfb, port)
+        await app.stop()
+        return seq, batched, ttfbs
+
+    seq, batched, ttfbs = asyncio.run(run_loads())
+    p50, p99 = _percentiles(ttfbs)
+    return {
+        "device_gain_curve": gain,
+        "grpc_emb_per_s_concurrency_1": round(seq, 1),
+        "grpc_emb_per_s_concurrency_32": round(batched, 1),
+        "batching_gain": round(batched / seq, 2) if seq else None,
+        "stream_ttfb_ms": {"p50": p50, "p99": p99, "samples": len(ttfbs)},
+        "note": ("grpc path numbers include the relay per-call dispatch "
+                 "floor (see `relay`); concurrency 32 shows the dynamic "
+                 "batcher amortizing it across a coalesced batch"),
     }
 
 
@@ -404,10 +704,11 @@ def _llama_decode_bench(on_tpu: bool) -> dict:
 
     async def run_streams():
         # precompile the ladder BEFORE timing: round 2 shipped 43 tok/s
-        # because four TPU compiles landed inside the timed window. Fills
-        # stay < 120 for every request here, so only the 128 window rung
-        # is ever scheduled — warm just that column of the matrix.
-        await engine.warmup(prompt_counts=(1, 8), windows=(128,))
+        # because four TPU compiles landed inside the timed window. The
+        # throughput rounds stay < 120 fill (128 rung), but the
+        # under-load TTFT's 192-token background generations climb past
+        # 112 into the 256 rung — warm both columns of the matrix.
+        await engine.warmup(prompt_counts=(1, 8), windows=(128, 256))
         await engine.start()
         # settle: budget 16 = prefill + k8+k4+k2+k1 ticks — exercises EVERY
         # ladder rung in-engine, absorbing each executable's one-time
@@ -422,28 +723,30 @@ def _llama_decode_bench(on_tpu: bool) -> dict:
                 for i in range(8)])
             elapsed = time.perf_counter() - start
             rates.append(sum(len(o) for o in outs) / elapsed)
-        ttfts = await _llama_stream_ttft(engine)
+        ttfts, ttft_loaded = await _llama_stream_ttft(engine)
         await engine.stop()
-        return rates, ttfts
+        return rates, ttfts, ttft_loaded
 
-    rates, ttfts = asyncio.run(run_streams())
+    rates, ttfts, ttft_loaded = asyncio.run(run_streams())
     p50, p99 = _percentiles(ttfts)
+    median_rate = float(np.median(rates))
+    if ttft_loaded.get("aggregate_tok_s"):
+        ttft_loaded["tok_s_vs_unloaded"] = round(
+            ttft_loaded["aggregate_tok_s"] / median_rate, 2)
     return {
         "tok_s_best": round(max(rates), 1),
-        "tok_s_median": round(float(np.median(rates)), 1),
+        "tok_s_median": round(median_rate, 1),
         "tok_s_min": round(min(rates), 1),
         "rounds": len(rates),
         "ttft": {"p50_ms": p50, "p99_ms": p99, "requests": len(ttfts),
                  "note": "sequential, via HTTP SSE /generate/stream"},
+        "ttft_under_load": ttft_loaded,
     }
 
 
-async def _llama_stream_ttft(engine) -> list:
-    """TTFT through the REAL serve path: HTTP server → SSE Stream response
-    → engine.generate_stream. One byte-level client measures
-    request-start → first `data:` frame, sequentially (TTFT under load is
-    the throughput rounds' job; this isolates the streaming latency).
-    Runs on the engine's own event loop (its queues are loop-bound)."""
+def _build_stream_app(engine):
+    """App serving POST /generate/stream over SSE from ``engine``. The
+    request body may carry {"max_new_tokens": N} (default 24)."""
     from gofr_tpu.app import App
     from gofr_tpu.container import new_mock_container
     from gofr_tpu.http.response import Stream
@@ -454,8 +757,12 @@ async def _llama_stream_ttft(engine) -> list:
     app.metrics_port = 0
 
     async def generate_stream(ctx):
+        try:
+            tokens = int((ctx.bind() or {}).get("max_new_tokens", 24))
+        except Exception:  # noqa: BLE001 — empty body
+            tokens = 24
         stream = await engine.generate_stream([1, 2, 3, 4] * 4,
-                                              max_new_tokens=24)
+                                              max_new_tokens=tokens)
 
         async def frames():
             async for token_id in stream:
@@ -464,33 +771,89 @@ async def _llama_stream_ttft(engine) -> list:
         return Stream(frames(), sse=True, on_close=stream.cancel)
 
     app.post("/generate/stream", generate_stream)
+    return app
 
+
+async def _stream_once(port: int, max_new_tokens: int = 24):
+    """One SSE client: returns (ttft_seconds, tokens_received). Drains the
+    stream to EOF so the engine slot frees cleanly."""
+    body = json.dumps({"max_new_tokens": max_new_tokens}).encode()
+    head = (b"POST /generate/stream HTTP/1.1\r\nHost: bench\r\n"
+            b"Connection: close\r\nContent-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(body)) + body
+    t0 = time.perf_counter()
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(head)
+    await writer.drain()
+    ttft = None
+    count = 0
+    while True:
+        # bounded read: an engine failure path must fail the bench after
+        # 30 s, not wedge it forever on a silent open connection
+        line = await asyncio.wait_for(reader.readline(), 30.0)
+        if line.startswith(b"data:"):
+            count += 1
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            continue
+        if not line:
+            break
+    writer.close()
+    if ttft is None:
+        raise RuntimeError("stream closed before first token")
+    return ttft, count
+
+
+async def _llama_stream_ttft(engine) -> tuple:
+    """TTFT through the REAL serve path: HTTP server → SSE Stream response
+    → engine.generate_stream. Runs on the engine's own event loop (its
+    queues are loop-bound).
+
+    Two regimes (VERDICT r4 weak #5 — the loaded number is what an
+    operator cares about):
+    - sequential: one client at a time, idle engine — the latency floor;
+    - under load: every slot is already decoding a long generation, then
+      2x max_slots probes arrive concurrently — TTFT includes admission
+      contention with inflight ticks and waiting for slots to free.
+    Returns (sequential_ttfts, loaded_result_dict)."""
+    app = _build_stream_app(engine)
     await app.start()
     port = app._http_server.bound_port
-    ttfts = []
-    head = (b"POST /generate/stream HTTP/1.1\r\nHost: bench\r\n"
-            b"Connection: close\r\nContent-Length: 0\r\n\r\n")
+
+    seq_ttfts = []
     for _ in range(16):
-        t0 = time.perf_counter()
-        reader, writer = await asyncio.open_connection("127.0.0.1", port)
-        writer.write(head)
-        await writer.drain()
-        while True:
-            line = await reader.readline()
-            if line.startswith(b"data:"):
-                ttfts.append(time.perf_counter() - t0)
-                break
-            if not line:
-                raise RuntimeError("stream closed before first token")
-        # drain to EOF (Connection: close) so the engine slot frees cleanly
-        try:
-            while await asyncio.wait_for(reader.read(4096), 10.0):
-                pass
-        except asyncio.TimeoutError:
-            pass                        # engine failure path: don't wedge
-        writer.close()
+        ttft, _count = await _stream_once(port)
+        seq_ttfts.append(ttft)
+
+    # saturate: one long generation per slot, probes contend for admission
+    n_slots = engine.max_slots
+    probes = 2 * n_slots
+    t_all = time.perf_counter()
+    background = [
+        asyncio.ensure_future(_stream_once(port, max_new_tokens=192))
+        for _ in range(n_slots)]
+    await asyncio.sleep(0.05)           # let the background fill the slots
+    results = await asyncio.gather(
+        *[_stream_once(port) for _ in range(probes)])
+    bg = await asyncio.gather(*background)
+    elapsed_all = time.perf_counter() - t_all
+    loaded_ttfts = [ttft for ttft, _ in results]
+    total_tokens = sum(count for _, count in results) \
+        + sum(count for _, count in bg)
+    p50, p99 = _percentiles(loaded_ttfts)
+    loaded = {
+        "p50_ms": p50, "p99_ms": p99, "requests": probes,
+        "busy_slots": n_slots,
+        "aggregate_tok_s": round(total_tokens / elapsed_all, 1),
+        "background_complete": all(count == 192 for _, count in bg),
+        "note": ("probes issued concurrently against an engine whose "
+                 "every slot is mid-generation; TTFT includes slot-wait "
+                 "+ admission contention with inflight decode ticks; "
+                 "aggregate_tok_s spans the whole mixed window incl. "
+                 "probe prefills interleaving the decode loop"),
+    }
     await app.stop()
-    return ttfts
+    return seq_ttfts, loaded
 
 
 def _llama7b_int8_bench(on_tpu: bool):
@@ -501,14 +864,24 @@ def _llama7b_int8_bench(on_tpu: bool):
     decode throughput depends only on layout). Reports aggregate tok/s
     and the fraction of the HBM-bandwidth roofline achieved.
 
-    r4: decode attention is fill-bounded by the engine's window ladder,
-    so a tick streams weights + only the live window of the cache. The
-    roofline is recomputed honestly for those byte counts: streamed
-    cache bytes are scaled by window/max_len, the rung derived the same
-    way the engine picks it. The KV cache stays bf16: int8-KV was built
-    and measured ~12% slower through plain XLA (the dequant convert
-    un-fuses — see LlamaConfig.kv_int8's post-mortem), so it ships as a
-    capacity option, not the bench config."""
+    r5 operating point (measured sweep over slots {16,24,32,40,48,56,64}
+    x K {16,32,64} x max_len {256,512}): **48 slots x K=32 fused steps,
+    max_len 256, full-window attention** — device-only 2343 tok/s at
+    0.778 of the HBM roofline, vs r4's 16x16@512 at 730 tok/s / 0.428.
+    What moved: (1) K=32 drops per-step overhead 21.9→20.5 ms/step at
+    48 slots (14.1 at 16 slots) by amortizing per-tick cost inside the
+    scan; (2) tripling slots amortizes the 6.16 GB weight stream per
+    step. Post-mortems from the sweep: 56 slots reaches 2516 tok/s but
+    leaves <2 GB HBM headroom (64 fails to compile), so 48 ships;
+    K=64 measured no better than K=32 (17.2 vs 17.4 ms/step @32 slots);
+    the fill-bounded 128 window at K=32/48 slots measured 29.4 ms/step
+    vs 20.5 full-window — the windowed dynamic-slice gather breaks XLA's
+    cache-read pipelining at this scale, so full-window wins at
+    max_len 256 and the roofline counts the full cache honestly.
+    The KV cache stays bf16: int8-KV was built and measured ~12% slower
+    through plain XLA (the dequant convert un-fuses — see
+    LlamaConfig.kv_int8's post-mortem), so it ships as a capacity
+    option, not the bench config."""
     if not on_tpu:
         return None
     import math
@@ -558,14 +931,14 @@ def _llama7b_int8_bench(on_tpu: bool):
         "lm_head": qrand(8, d, cfg.vocab_size),
     }
 
-    # operating point (r4, measured sweep): 16 slots × K=16 fused steps ×
-    # 6-deep fetch pipeline = 676 tok/s on this harness vs 501 at
-    # 8×K16 and 480 at 8×K8 — weights stream once per step regardless of
-    # batch, so doubling slots nearly doubles aggregate until attention/
-    # activation compute catches up.
+    # r5 operating point from the measured sweep (docstring): 48 slots x
+    # K=32 x max_len=256, full-window attention. 56 slots measured 7%
+    # faster but leaves <2 GB HBM headroom on a 16 GB chip — too tight
+    # for an unattended bench (64 already fails to compile).
+    slots, k_steps = 48, 32
     container = new_mock_container()
-    engine = GenerationEngine(cfg, params, max_slots=16, max_len=512,
-                              prompt_buckets=(32,), steps_per_tick=16,
+    engine = GenerationEngine(cfg, params, max_slots=slots, max_len=256,
+                              prompt_buckets=(32,), steps_per_tick=k_steps,
                               max_inflight_ticks=6,
                               logger=container.logger,
                               metrics=container.metrics)
@@ -577,31 +950,30 @@ def _llama7b_int8_bench(on_tpu: bool):
     weight_bytes = leaf_bytes({"layers": params["layers"],
                                "head": params["lm_head"]})
     cache_bytes = leaf_bytes(engine.cache)
-    # fill-bounded attention: every request here peaks at fill 16+81=97,
-    # +16 fused steps < 128, so the engine schedules the 128 rung
-    # throughout — derive it exactly as the engine will, and count only
-    # that live fraction of the cache as streamed per step (the dead
-    # tail is never read)
-    budget = 81     # prefill + 80 decode = exactly 5 fused K=16 ticks
-    window = engine._pick_window([16 + budget], 16)
+    # requests peak at fill 16+81=97; +32 fused steps = 129 > the 128
+    # rung, so the engine schedules the full-window executable (which the
+    # sweep found faster than the 128 rung at this scale anyway) — the
+    # roofline counts the FULL cache streamed per step, honestly
+    budget = 81     # prefill + 80 decode = K32+K32+K16 ticks
+    window = engine._pick_window([16 + budget], k_steps)
     window_frac = 1.0 if window is None else window / engine.max_len
     step_bytes = weight_bytes + cache_bytes * window_frac
     hbm_bw = 819e9                            # v5e spec
 
     async def run_streams():
-        await engine.warmup(prompt_counts=(16,), ks=(16,),
+        await engine.warmup(prompt_counts=(slots,), ks=(16, 32),
                             windows=(window,))
         await engine.start()
-        # settle = 1 prefill + exactly one K=16 tick: absorbs the one-time
+        # settle = 1 prefill + exactly one K=32 tick: absorbs the one-time
         # first-execution stall (relayout after warmup's donated buffers)
         # that otherwise lands inside the timed window
         await asyncio.gather(*[
-            engine.generate([i + 1] * 16, max_new_tokens=17)
-            for i in range(16)])
+            engine.generate([i + 1] * 16, max_new_tokens=33)
+            for i in range(slots)])
         start = time.perf_counter()
         outs = await asyncio.gather(*[
             engine.generate([i + 1] * 16, max_new_tokens=budget)
-            for i in range(16)])
+            for i in range(slots)])
         elapsed = time.perf_counter() - start
         await engine.stop()
         return sum(len(o) for o in outs) / elapsed
@@ -613,7 +985,7 @@ def _llama7b_int8_bench(on_tpu: bool):
     # does not reliably barrier through the relay), and take
     # (t12 - t2) / 10 — fixed dispatch/fetch overhead cancels, leaving
     # the true per-tick device time a real TPU host would sustain.
-    fn = engine._decode_fn(16, window=window)
+    fn = engine._decode_fn(k_steps, window=window)
     active = jnp.zeros((engine.max_slots,), bool)
     tokens_dev, cache, cache_len = fn(engine.params, engine.last_token,
                                       engine.cache, engine.cache_len,
@@ -632,7 +1004,7 @@ def _llama7b_int8_bench(on_tpu: bool):
     slopes = [(chain(12) - chain(2)) / 10 for _ in range(3)]
     slope = float(np.median(slopes))
     device_tick_s = slope if slope > 0 else None   # None = failed measure
-    device_tok_s = (engine.max_slots * 16 / device_tick_s
+    device_tok_s = (engine.max_slots * k_steps / device_tick_s
                     if device_tick_s else None)
 
     roofline = engine.max_slots * hbm_bw / step_bytes
@@ -646,17 +1018,19 @@ def _llama7b_int8_bench(on_tpu: bool):
             "device_tick_ms": round(device_tick_s * 1e3, 2)
             if device_tick_s else None,
             "slots": engine.max_slots,
-            "steps_per_tick": 16,
+            "steps_per_tick": k_steps,
             "weights_gb": round(weight_bytes / 2**30, 2),
             "kv_cache_gb": round(cache_bytes / 2**30, 2),
             "kv_cache_dtype": "bf16",
             "attention_window": window or engine.max_len,
             "streamed_bytes_per_step_gb": round(step_bytes / 2**30, 2),
-            "note": ("roofline counts weights + live cache window per "
-                     "step; r3's 0.657 frac divided by full-window bytes "
-                     "— same measurement here reads lower against the "
-                     "honest (smaller) denominator while tok/s rose "
-                     "491→676")}
+            "note": ("r5 sweep moved the operating point 16x16@512 -> "
+                     "48xK32@256 full-window: K=32 amortizes per-step "
+                     "overhead, 3x slots amortize the 6.16 GB weight "
+                     "stream; device-only rose 730 -> ~2300 tok/s and "
+                     "roofline frac 0.428 -> ~0.78 (post-mortems for "
+                     "56/64-slot, K=64 and windowed variants in the "
+                     "function docstring)")}
 
 
 if __name__ == "__main__":

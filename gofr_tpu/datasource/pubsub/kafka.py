@@ -299,6 +299,96 @@ class _Broker:
             pass
 
 
+class _PartitionFetcher(threading.Thread):
+    """One fetch loop per assigned partition over its OWN broker
+    connection (parity: kafka-go gives every reader its own dialer,
+    kafka.go:181-186): a slow partition leader — or an empty partition
+    long-polling at the broker — no longer head-of-line blocks its
+    siblings, and heartbeats move to the coordinator loop instead of
+    interleaving with fetch latency. Errors are recorded on ``.error``
+    and end the thread; the owning poller notices and restarts the
+    assignment pass."""
+
+    def __init__(self, client: "KafkaClient", topic: str, partition: int,
+                 offset: int, q: "queue.Queue", make_committer,
+                 stop: threading.Event):
+        super().__init__(daemon=True, name=f"kafka-{topic}[{partition}]")
+        self.client = client
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+        self.q = q
+        self.make_committer = make_committer
+        self.stop_event = stop
+        self.error: Optional[BaseException] = None
+
+    def _stopping(self) -> bool:
+        return self.stop_event.is_set() or self.client._closed
+
+    def run(self) -> None:
+        client = self.client
+        conn: Optional[_Broker] = None
+        try:
+            while not self._stopping():
+                if conn is None:
+                    host, port = client._leader_addr(self.topic,
+                                                     self.partition)
+                    try:
+                        conn = _Broker(host, port, client.client_id)
+                    except OSError:
+                        # leader down or still restarting: keep healing
+                        # in-place — dying here would tear down every
+                        # sibling fetcher for one partition's outage
+                        client._refresh_metadata(self.topic)
+                        time.sleep(0.5)
+                        continue
+                started = time.monotonic()
+                try:
+                    batch = client._fetch(self.topic, self.partition,
+                                          self.offset, broker=conn)
+                except KafkaOffsetOutOfRange:
+                    # retention expired past our offset: reset to earliest
+                    self.offset = client._earliest_offset(self.topic,
+                                                          self.partition)
+                    continue
+                except (OSError, ConnectionError):
+                    # dead conn or moved leader: re-resolve on a fresh
+                    # socket rather than dying (leadership moves heal
+                    # in-place, matching the old shared-conn behaviour)
+                    conn.close()
+                    conn = None
+                    client._refresh_metadata(self.topic)
+                    time.sleep(0.2)
+                    continue
+                for offset, key, value in batch:
+                    self.offset = offset + 1
+                    message = Message(
+                        self.topic, value, key,
+                        metadata={"partition": self.partition,
+                                  "offset": offset},
+                        committer=self.make_committer(self.partition,
+                                                      offset + 1))
+                    while not self._stopping():
+                        try:
+                            self.q.put(message, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
+                if not batch:
+                    # a broker honouring fetch_max_wait_ms already parked
+                    # us server-side; only top up if it returned early,
+                    # so an empty partition never busy-spins
+                    remaining = client.fetch_max_wait_ms / 1000.0 \
+                        - (time.monotonic() - started)
+                    if remaining > 0:
+                        time.sleep(min(remaining, 0.5))
+        except BaseException as exc:  # noqa: BLE001 — reported to poller
+            self.error = exc
+        finally:
+            if conn is not None:
+                conn.close()
+
+
 class KafkaClient(PubSub):
     def __init__(self, config, logger, metrics):
         self.logger = logger
@@ -333,10 +423,18 @@ class KafkaClient(PubSub):
                     self.group)
 
     def _broker(self, addr: Tuple[str, int]) -> _Broker:
+        # N per-partition fetchers + the event loop's committers race this
+        # cache; a bare check-then-insert would leak the loser's socket.
+        # Connect OUTSIDE the lock (it can block up to the 10 s timeout),
+        # publish under it, close the losing duplicate.
         broker = self._brokers.get(addr)
-        if broker is None:
-            broker = _Broker(addr[0], addr[1], self.client_id)
-            self._brokers[addr] = broker
+        if broker is not None:
+            return broker
+        candidate = _Broker(addr[0], addr[1], self.client_id)
+        with self._meta_lock:
+            broker = self._brokers.setdefault(addr, candidate)
+        if broker is not candidate:
+            candidate.close()
         return broker
 
     # -- metadata / leader routing -----------------------------------------
@@ -371,12 +469,15 @@ class KafkaClient(PubSub):
                             self._leaders[(topic, partition)] = nodes[leader]
         return sorted(partitions)
 
-    def _leader(self, topic: str, partition: int) -> _Broker:
+    def _leader_addr(self, topic: str, partition: int) -> Tuple[str, int]:
         addr = self._leaders.get((topic, partition))
         if addr is None:
             self._refresh_metadata(topic)
             addr = self._leaders.get((topic, partition), self.bootstrap)
-        return self._broker(addr)
+        return addr
+
+    def _leader(self, topic: str, partition: int) -> _Broker:
+        return self._broker(self._leader_addr(topic, partition))
 
     # -- produce ------------------------------------------------------------
     def publish(self, topic: str, payload: bytes, key: bytes = b"") -> None:
@@ -607,6 +708,32 @@ class KafkaClient(PubSub):
         else:
             self._poll_topic_group(topic)
 
+    def _spawn_fetchers(self, topic: str, offsets: Dict[int, int],
+                        make_committer, stop: "threading.Event"
+                        ) -> Dict[int, "_PartitionFetcher"]:
+        fetchers = {
+            partition: _PartitionFetcher(self, topic, partition, offset,
+                                         self._queues[topic],
+                                         make_committer, stop)
+            for partition, offset in offsets.items()}
+        for fetcher in fetchers.values():
+            fetcher.start()
+        return fetchers
+
+    @staticmethod
+    def _check_fetchers(fetchers: Dict[int, "_PartitionFetcher"]) -> None:
+        for fetcher in fetchers.values():
+            if not fetcher.is_alive():
+                raise fetcher.error or KafkaError(
+                    f"fetcher for partition {fetcher.partition} died")
+
+    @staticmethod
+    def _stop_fetchers(fetchers: Dict[int, "_PartitionFetcher"],
+                       stop: "threading.Event") -> None:
+        stop.set()
+        for fetcher in fetchers.values():
+            fetcher.join(timeout=5.0)
+
     def _poll_topic_group(self, topic: str) -> None:
         """Group-coordinated fetch loop: join the consumer group, fetch
         only the partitions the leader assigned to this member, heartbeat,
@@ -631,68 +758,51 @@ class KafkaClient(PubSub):
                                                        coordinator)
                     offsets[partition] = committed or self._earliest_offset(
                         topic, partition)
-                next_heartbeat = time.monotonic() + heartbeat_s
 
-                def maybe_heartbeat():
-                    # interleaved between partition fetches and queue puts:
-                    # a long pass (many long-polling partitions, slow
-                    # consumer) must not outlive the session timeout
-                    nonlocal next_heartbeat
-                    if time.monotonic() >= next_heartbeat:
-                        self._heartbeat(coordinator, generation, member_id)
-                        next_heartbeat = time.monotonic() + heartbeat_s
+                # one fetcher thread + dedicated connection per assigned
+                # partition (kafka.go:181-186: kafka-go reader-per-
+                # partition concurrency): a slow partition leader or an
+                # empty long-polling partition can't head-of-line block
+                # its siblings. Commits ride the shared broker cache, NOT
+                # the group conn: a rebalance blocks the group conn
+                # server-side for seconds, and commit() runs on the app's
+                # event loop.
+                def make_committer(partition, next_offset):
+                    return self._make_committer(topic, partition,
+                                                next_offset, generation,
+                                                member_id)
 
-                def put_with_heartbeat(message):
-                    while not self._closed:
-                        try:
-                            q.put(message, timeout=min(0.5, heartbeat_s))
-                            return
-                        except queue.Full:
-                            maybe_heartbeat()
-
+                stop = threading.Event()
+                fetchers = self._spawn_fetchers(topic, offsets,
+                                                make_committer, stop)
                 known_partition_count = len(self._refresh_metadata(topic))
                 refresh_at = time.monotonic() + 30.0
-                while not self._closed:
-                    got_any = False
-                    for partition in partitions:
-                        try:
-                            batch = self._fetch(topic, partition,
-                                                offsets[partition])
-                        except KafkaOffsetOutOfRange:
-                            offsets[partition] = self._earliest_offset(
-                                topic, partition)
-                            continue
-                        for offset, key, value in batch:
-                            offsets[partition] = offset + 1
-                            # commits ride the shared broker cache, NOT the
-                            # group conn: a rebalance blocks the group conn
-                            # server-side for seconds, and commit() runs on
-                            # the app's event loop
-                            committer = self._make_committer(
-                                topic, partition, offset + 1, generation,
-                                member_id)
-                            put_with_heartbeat(Message(
-                                topic, value, key,
-                                metadata={"partition": partition,
-                                          "offset": offset},
-                                committer=committer))
-                            got_any = True
-                        maybe_heartbeat()
-                    backoff = 0.1
-                    maybe_heartbeat()
-                    if time.monotonic() >= refresh_at:
-                        # re-learn leadership (moves heal without an error)
-                        # and detect partition growth, which the group must
-                        # rebalance over (the coordinator won't tell us)
-                        current = len(self._refresh_metadata(topic))
-                        refresh_at = time.monotonic() + 30.0
-                        if current != known_partition_count:
-                            raise KafkaRebalance(
-                                f"partition count changed "
-                                f"{known_partition_count} -> {current}")
-                    if not got_any:
-                        time.sleep(min(self.fetch_max_wait_ms / 1000.0,
-                                       heartbeat_s))
+                try:
+                    # the poller thread is now the pure coordinator loop:
+                    # heartbeat on schedule (no longer entangled with
+                    # fetch latency or a slow consumer's queue drain),
+                    # watch fetcher health, detect partition growth
+                    while not self._closed:
+                        self._heartbeat(coordinator, generation, member_id)
+                        deadline = time.monotonic() + heartbeat_s
+                        while time.monotonic() < deadline \
+                                and not self._closed:
+                            self._check_fetchers(fetchers)
+                            time.sleep(0.05)
+                        backoff = 0.1
+                        if time.monotonic() >= refresh_at:
+                            # re-learn leadership (moves heal without an
+                            # error) and detect partition growth, which
+                            # the group must rebalance over (the
+                            # coordinator won't tell us)
+                            current = len(self._refresh_metadata(topic))
+                            refresh_at = time.monotonic() + 30.0
+                            if current != known_partition_count:
+                                raise KafkaRebalance(
+                                    f"partition count changed "
+                                    f"{known_partition_count} -> {current}")
+                finally:
+                    self._stop_fetchers(fetchers, stop)
             except KafkaRebalance as exc:
                 if self._closed:
                     break
@@ -735,41 +845,44 @@ class KafkaClient(PubSub):
                     committed = self._committed_offset(topic, partition)
                     offsets[partition] = committed or self._earliest_offset(
                         topic, partition)
+
+                def make_committer(partition, next_offset):
+                    return self._make_committer(topic, partition,
+                                                next_offset)
+
+                # per-partition fetcher threads (see _PartitionFetcher):
+                # this loop just watches health and partition growth
+                stop = threading.Event()
+                fetchers = self._spawn_fetchers(topic, offsets,
+                                                make_committer, stop)
                 refresh_at = time.monotonic() + metadata_refresh_s
-                while not self._closed:
-                    got_any = False
-                    for partition in partitions:
-                        try:
-                            batch = self._fetch(topic, partition,
-                                                offsets[partition])
-                        except KafkaOffsetOutOfRange:
-                            # retention expired past the committed offset:
-                            # reset to earliest (auto.offset.reset analog)
-                            offsets[partition] = self._earliest_offset(
-                                topic, partition)
-                            continue
-                        for offset, key, value in batch:
-                            offsets[partition] = offset + 1
-                            committer = self._make_committer(
-                                topic, partition, offset + 1)
-                            q.put(Message(topic, value, key,
-                                          metadata={"partition": partition,
-                                                    "offset": offset},
-                                          committer=committer))
-                            got_any = True
-                    backoff = 0.1   # a clean pass resets the backoff
-                    if time.monotonic() >= refresh_at:
-                        # periodically re-learn partitions (growth after
-                        # subscribe) without waiting for an error
-                        new = self._refresh_metadata(topic)
-                        for partition in new:
-                            if partition not in offsets:
-                                offsets[partition] = self._earliest_offset(
-                                    topic, partition)
-                        partitions = new or partitions
-                        refresh_at = time.monotonic() + metadata_refresh_s
-                    if not got_any:
-                        time.sleep(self.fetch_max_wait_ms / 1000.0)
+                healthy_at = time.monotonic() + 2.0
+                try:
+                    while not self._closed:
+                        self._check_fetchers(fetchers)
+                        if time.monotonic() >= healthy_at:
+                            # only a *sustained* healthy pass resets the
+                            # backoff — fetchers dying right after spawn
+                            # (non-retryable fetch error) must keep
+                            # escalating toward the 10 s cap, not hot-loop
+                            backoff = 0.1
+                        if time.monotonic() >= refresh_at:
+                            # periodically re-learn partitions (growth
+                            # after subscribe) without waiting for error
+                            refresh_at = time.monotonic() \
+                                + metadata_refresh_s
+                            for partition in self._refresh_metadata(topic):
+                                if partition not in fetchers:
+                                    fetcher = _PartitionFetcher(
+                                        self, topic, partition,
+                                        self._earliest_offset(topic,
+                                                              partition),
+                                        q, make_committer, stop)
+                                    fetcher.start()
+                                    fetchers[partition] = fetcher
+                        time.sleep(0.05)
+                finally:
+                    self._stop_fetchers(fetchers, stop)
             except Exception as exc:
                 if self._closed:
                     break
@@ -786,13 +899,16 @@ class KafkaClient(PubSub):
         return lambda: self._commit_offset(topic, partition, next_offset,
                                            generation, member_id, broker)
 
-    def _fetch(self, topic: str, partition: int,
-               offset: int) -> List[Tuple[int, bytes, bytes]]:
+    def _fetch(self, topic: str, partition: int, offset: int,
+               broker: Optional[_Broker] = None
+               ) -> List[Tuple[int, bytes, bytes]]:
         body = (struct.pack(">iii", -1, self.fetch_max_wait_ms, 1)
                 + struct.pack(">i", 1) + _string(topic)
                 + struct.pack(">i", 1)
                 + struct.pack(">iqi", partition, offset, 4 * 1024 * 1024))
-        reader = self._leader(topic, partition).call(API_FETCH, 2, body)
+        conn = broker if broker is not None \
+            else self._leader(topic, partition)
+        reader = conn.call(API_FETCH, 2, body)
         reader.int32()                                # throttle time
         out: List[Tuple[int, bytes, bytes]] = []
         for _ in range(reader.int32()):

@@ -81,8 +81,28 @@ async def live_handler(request: Request):
     return 200, {"Content-Type": "application/json"}, b'{"status":"UP"}'
 
 
+_FAVICON: "bytes | None" = None    # None = not read yet; b"" = unavailable
+
+
 async def favicon_handler(request: Request):
-    return 204, {}, b""
+    """Serve the bundled icon (handler.go:108 faviconHandler serves
+    static/favicon.ico); an original gofr-tpu icon, lazily read once —
+    including a failed read, so a missing file costs one syscall total,
+    not one per tab-load."""
+    global _FAVICON
+    if _FAVICON is None:
+        import os
+        path = os.path.join(os.path.dirname(__file__), "static",
+                            "favicon.ico")
+        try:
+            with open(path, "rb") as fh:
+                _FAVICON = fh.read()
+        except OSError:
+            _FAVICON = b""
+    if not _FAVICON:
+        return 204, {}, b""
+    return 200, {"Content-Type": "image/x-icon",
+                 "Cache-Control": "public, max-age=86400"}, _FAVICON
 
 
 async def catch_all_handler(request: Request):

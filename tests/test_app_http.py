@@ -144,7 +144,9 @@ def test_health_and_alive_and_favicon():
             alive = await http_request(port, "GET", "/.well-known/alive")
             assert alive.json() == {"status": "UP"}
             fav = await http_request(port, "GET", "/favicon.ico")
-            assert fav.status == 204
+            assert fav.status == 200
+            assert fav.headers["content-type"] == "image/x-icon"
+            assert fav.body[:4] == b"\x00\x00\x01\x00"   # ICO magic
     run(main())
 
 
