@@ -7,9 +7,10 @@ on the classify path. This bench measures all of it honestly:
    serving batch (MXU utilisation ceiling), with MFU computed from XLA's
    own cost analysis against the chip's bf16 peak.
 2. **Operating point** — a device-attributable sweep over the bucket
-   ladder (8..256, paired-slope timing per bucket); the operating point is
-   the largest bucket fitting the p99 < 10 ms budget at ≥1000 req/s, the
-   full sweep is reported so the knee is visible.
+   ladder (8..256, each bucket timed by iterating the step inside ONE
+   executable so the relay round trip cancels exactly); the operating
+   point is the largest bucket fitting the p99 < 10 ms budget at
+   ≥1000 req/s, the full sweep is reported so the knee is visible.
 3. **Closed-loop HTTP** — real requests through router → middleware →
    handler → dynamic batcher → executor (the path BASELINE.md names),
    reporting measured p50/p99 for /hello (framework overhead, config 1)
@@ -64,10 +65,22 @@ REGRESSION_NOTES = {
         "compare against the same-run `relay` block, not across rounds"),
     "resnet50_classify_req_s": (
         "relay-included headline; the stable cross-round number is "
-        "device_only_req_per_s (paired-slope, dispatch floor cancelled)"),
+        "device_only_req_per_s (in-executable chain, dispatch floor "
+        "cancelled)"),
+    "device_only_req_per_s": (
+        "r5 replaced the multi-dispatch paired-slope method (which "
+        "absorbed 0.5-3 ms/call of relay jitter and under-read the "
+        "device by up to 30% on bad relay days) with a single-dispatch "
+        "in-executable lax.fori_loop chain; the r4 number was measured "
+        "with the old method"),
+    "mfu": (
+        "derived from device_only_req_per_s — same r5 measurement-method "
+        "change (in-executable chain vs multi-dispatch slope)"),
     "llama_small_decode_tok_s": (
         "engine aggregate includes host-side dispatch through the relay; "
-        "relay round-trip p50 varied 18-128 ms across rounds"),
+        "relay round-trip p50 varied 18-128 ms across rounds. r5 raised "
+        "steps_per_tick 8->32 (a K=8 tick cost less device time than its "
+        "relay dispatch) and sized request budgets to whole K=32 ticks"),
     "llama7b_decode_tok_s": (
         "engine aggregate through the relay; device_only_tok_s is the "
         "hardware-attributable metric. r5 moved the operating point to "
@@ -241,9 +254,10 @@ def _chained_device_latency(make_step, params, x, batch: int,
             return jnp.sum(acc.astype(jnp.float32))   # 4-byte fetch
         return jax.jit(fn).lower(params, x).compile()
 
-    # iterate enough that the signal dwarfs round-trip jitter, bounded
-    # so big batches don't take seconds per rep
-    n = max(8, min(128, 2048 // max(1, batch)))
+    # iterate enough that the signal dwarfs round-trip jitter (a floor of
+    # 8 let a lucky rep read batch-256 ResNet at 11 ms vs its true ~20 —
+    # spread 1.0 flagged it), bounded so big batches stay ~1 s per rep
+    n = max(24, min(128, 2048 // max(1, batch)))
     big = chained(n)
     small = chained(2)
     np.asarray(big(params, x))      # warm both executables
@@ -554,7 +568,8 @@ def _bert_grpc_bench(on_tpu: bool) -> dict:
 
     Three views, because the *batching gain curve* is the point:
     1. Device-side ceiling — the compiled embed step at batch 1/8/32 via
-       paired slopes: what one chip sustains per batch shape.
+       the in-executable timing chain: what one chip sustains per batch
+       shape.
     2. Full gRPC unary path at concurrency 1 vs 32 — through grpc.aio,
        dynamic JSON codec, context middleware, and the dynamic batcher;
        the concurrency-32 number shows the batcher coalescing real
@@ -719,12 +734,19 @@ def _llama_decode_bench(on_tpu: bool) -> dict:
     cfg = llama.config(preset, max_seq_len=1024)
     params = llama.init(cfg, jax.random.PRNGKey(0))
     container = new_mock_container()
+    # K=32 fused steps (r5, mirroring the 7B finding): a llama-small K=8
+    # tick is ~60 ms device vs ~115 ms relay dispatch — the harness was
+    # paying more to launch ticks than to run them. The adaptive ladder
+    # still drops K when admissions wait, so TTFT stays bounded.
     engine = GenerationEngine(cfg, params, max_slots=8, max_len=512,
-                              prompt_buckets=(32,), steps_per_tick=8,
+                              prompt_buckets=(32,), steps_per_tick=32,
                               max_inflight_ticks=4,
                               logger=container.logger,
                               metrics=container.metrics)
-    tokens_each = 64 if on_tpu else 8
+    # 65 = 1 prefill token + exactly two fused K=32 ticks of decode per
+    # request — the budget never strands tokens on small tail rungs
+    # (64 would decay 32,16,8,4,2,1: six dispatches, each paying relay)
+    tokens_each = 65 if on_tpu else 8
     rounds = 5 if on_tpu else 2
 
     async def run_streams():
@@ -735,11 +757,14 @@ def _llama_decode_bench(on_tpu: bool) -> dict:
         # 112 into the 256 rung — warm both columns of the matrix.
         await engine.warmup(prompt_counts=(1, 8), windows=(128, 256))
         await engine.start()
-        # settle: budget 16 = prefill + k8+k4+k2+k1 ticks — exercises EVERY
-        # ladder rung in-engine, absorbing each executable's one-time
-        # first-call stall (warmup compiles don't absorb it on this host;
-        # see _llama7b_int8_bench) before the timed window
-        await engine.generate(list(range(8)), max_new_tokens=16)
+        # settle: absorbs each executable's one-time first-call stall
+        # (warmup compiles don't absorb it on this host; see
+        # _llama7b_int8_bench) before the timed window. Budget 64 decays
+        # 32+16+8+4+2+1 — every ladder rung executes once, so neither the
+        # timed rounds (K=32) nor the TTFT probes (small rungs) hit a
+        # first-execution stall (r5: a 33-token settle left K≤16 cold and
+        # put a 2.2 s outlier in sequential TTFT p99)
+        await engine.generate(list(range(8)), max_new_tokens=64)
         rates = []
         for _ in range(rounds):
             start = time.perf_counter()

@@ -341,7 +341,20 @@ class _PartitionFetcher(threading.Thread):
                                                          self.partition)
                         conn = _Broker(host, port, client.client_id)
                     if self.offset is None:
-                        self.offset = self.resolve_offset(self.partition)
+                        try:
+                            self.offset = self.resolve_offset(
+                                self.partition)
+                        except KafkaError:
+                            # coordinator loading / moved leadership
+                            # during offset lookup is transient and
+                            # partition-local: retry here instead of
+                            # letting it tear down every sibling (fetch
+                            # protocol errors below still escalate)
+                            client.logger.warn(
+                                "kafka %s[%d]: offset resolution failed, "
+                                "retrying", self.topic, self.partition)
+                            time.sleep(0.5)
+                            continue
                     batch = client._fetch(self.topic, self.partition,
                                           self.offset, broker=conn)
                 except KafkaOffsetOutOfRange:
@@ -349,7 +362,7 @@ class _PartitionFetcher(threading.Thread):
                     try:
                         self.offset = client._earliest_offset(
                             self.topic, self.partition)
-                    except (OSError, ConnectionError):
+                    except (OSError, ConnectionError, KafkaError):
                         time.sleep(0.5)
                     continue
                 except (OSError, ConnectionError):

@@ -73,7 +73,8 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
     Wraps all four RPC shapes with the same latency histogram
     (server-streaming/bidi timed from call to stream exhaustion with the
     outbound message count; client-streaming counts inbound messages),
-    labeling failures status=ERROR so error rate and error latency are
+    labeling failures status=ERROR and client deadline-expiry/
+    disconnects status=CANCELLED, so error rate and error latency are
     visible, not just successes — VERDICT r3 weak #6 / r4 weak #8: no
     RPC shape bypasses observability."""
 
@@ -110,6 +111,12 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
                     response = await inner(request, context)
                     self._observe(method, start, "OK")
                     return response
+                except asyncio.CancelledError:
+                    # client deadline/disconnect: the most common failure
+                    # class under load-shedding must not vanish from the
+                    # histogram
+                    self._observe(method, start, "CANCELLED")
+                    raise
                 except Exception as exc:
                     logger.error("gRPC %s failed: %r", method, exc)
                     self._observe(method, start, "ERROR")
@@ -135,6 +142,10 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
                     else:
                         await result   # handler streamed via context.write
                     self._observe(method, start, "OK", messages=count)
+                except (asyncio.CancelledError, GeneratorExit):
+                    self._observe(method, start, "CANCELLED",
+                                  messages=count)
+                    raise
                 except Exception as exc:
                     logger.error("gRPC %s failed after %d messages: %r",
                                  method, count, exc)
@@ -163,6 +174,10 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
                     self._observe(method, start, "OK",
                                   messages=received[0])
                     return response
+                except asyncio.CancelledError:
+                    self._observe(method, start, "CANCELLED",
+                                  messages=received[0])
+                    raise
                 except Exception as exc:
                     logger.error("gRPC %s failed after %d messages: %r",
                                  method, received[0], exc)
@@ -190,6 +205,10 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
                     else:
                         await result
                     self._observe(method, start, "OK", messages=count)
+                except (asyncio.CancelledError, GeneratorExit):
+                    self._observe(method, start, "CANCELLED",
+                                  messages=count)
+                    raise
                 except Exception as exc:
                     logger.error("gRPC %s failed after %d messages: %r",
                                  method, count, exc)

@@ -519,6 +519,47 @@ def test_grpc_client_and_bidi_streaming_observed():
     run(main())
 
 
+def test_grpc_client_cancel_observed_as_cancelled():
+    """A client disconnect/deadline mid-stream must land in the histogram
+    as status=CANCELLED — the most common failure class under load
+    shedding must not be invisible (r5 review finding)."""
+    import grpc
+
+    app = make_app()
+    app.grpc_port = 0
+
+    async def drip(ctx):
+        async def items():
+            yield {"n": 1}
+            await asyncio.sleep(30.0)      # parked until the client bails
+            yield {"n": 2}
+        return items()
+
+    app.register_grpc_stream("Slow", "drip", drip)
+
+    async def main():
+        await app.start()
+        try:
+            port = app._grpc_server.bound_port
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                call = ch.unary_stream("/gofr.Slow/drip")(b"{}")
+                async for _ in call:
+                    break                   # got one message
+                call.cancel()
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while asyncio.get_running_loop().time() < deadline:
+                value = app.container.metrics.value(
+                    "app_http_service_response", service="grpc",
+                    method="/gofr.Slow/drip", status="CANCELLED")
+                if value:
+                    break
+                await asyncio.sleep(0.05)
+            assert value == 1
+        finally:
+            await app.stop()
+    run(main())
+
+
 def test_grpc_stream_midstream_error_terminates_stream():
     """A producer failing after some items must deliver those items and
     then end the stream (logged server-side), never hang the client."""
