@@ -56,11 +56,14 @@ def build_app():
         max_slots=int(os.environ.get("GENERATE_SLOTS", "8")),
         max_len=min(cfg.max_seq_len, 1024),
         # fused decode steps per host round trip (amortises dispatch; the
-        # adaptive ladder drops back to 1 while admissions are waiting)
-        steps_per_tick=int(os.environ.get("STEPS_PER_TICK", "4")),
+        # adaptive ladder drops back to 1 while admissions are waiting).
+        # r5 measured K=8 ticks costing less device time than their own
+        # dispatch on a high-latency host — 16 is the safer default, 32
+        # for throughput-first serving (docs/tpu/benchmarking.md)
+        steps_per_tick=int(os.environ.get("STEPS_PER_TICK", "16")),
         # decode ticks in flight before the oldest fetch must land: token
         # fetches overlap device compute and each other (D2H pipelining)
-        max_inflight_ticks=int(os.environ.get("INFLIGHT_TICKS", "2")),
+        max_inflight_ticks=int(os.environ.get("INFLIGHT_TICKS", "4")),
         logger=app.logger, metrics=app.container.metrics)
     app.container.tpu = engine  # surfaces engine health under /.well-known
 

@@ -332,6 +332,7 @@ class _PartitionFetcher(threading.Thread):
     def run(self) -> None:
         client = self.client
         conn: Optional[_Broker] = None
+        offset_failures = 0
         try:
             while not self._stopping():
                 started = time.monotonic()
@@ -344,15 +345,24 @@ class _PartitionFetcher(threading.Thread):
                         try:
                             self.offset = self.resolve_offset(
                                 self.partition)
+                            offset_failures = 0
                         except KafkaError:
                             # coordinator loading / moved leadership
-                            # during offset lookup is transient and
-                            # partition-local: retry here instead of
-                            # letting it tear down every sibling (fetch
-                            # protocol errors below still escalate)
+                            # during offset lookup is usually transient
+                            # and partition-local: retry here a few times
+                            # instead of tearing down every sibling — but
+                            # a PERSISTENT failure (desynced shared
+                            # handle, authz error) must escalate to the
+                            # poller, whose full rejoin refreshes the
+                            # coordinator connection this loop never
+                            # could
+                            offset_failures += 1
+                            if offset_failures >= 6:
+                                raise
                             client.logger.warn(
-                                "kafka %s[%d]: offset resolution failed, "
-                                "retrying", self.topic, self.partition)
+                                "kafka %s[%d]: offset resolution failed "
+                                "(%d/6), retrying", self.topic,
+                                self.partition, offset_failures)
                             time.sleep(0.5)
                             continue
                     batch = client._fetch(self.topic, self.partition,
@@ -362,7 +372,15 @@ class _PartitionFetcher(threading.Thread):
                     try:
                         self.offset = client._earliest_offset(
                             self.topic, self.partition)
+                        offset_failures = 0
                     except (OSError, ConnectionError, KafkaError):
+                        offset_failures += 1
+                        if offset_failures >= 6:
+                            raise
+                        client.logger.warn(
+                            "kafka %s[%d]: earliest-offset reset failed "
+                            "(%d/6), retrying", self.topic,
+                            self.partition, offset_failures)
                         time.sleep(0.5)
                     continue
                 except (OSError, ConnectionError):

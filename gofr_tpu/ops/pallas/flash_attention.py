@@ -146,6 +146,17 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     tileable = (seq_len % block_q == 0 and seq_len % block_k == 0
                 and head_dim % 128 == 0 and seq_len >= 128)
     if not tileable:
+        if seq_len >= 8192:
+            import warnings
+
+            # the dense path materializes an S x S score matrix (16 GB
+            # bf16 at S=32K): falling back *silently* at long context
+            # turns a shape mistake into an opaque device OOM (r5)
+            warnings.warn(
+                f"flash_attention falling back to DENSE attention at "
+                f"S={seq_len} (untileable: head_dim {head_dim} must be a "
+                f"multiple of 128 and S divisible by the block sizes) — "
+                f"the S x S score matrix may exceed HBM", stacklevel=2)
         from gofr_tpu.ops.attention import attention, causal_mask
         mask = causal_mask(seq_len)[None, None, None] if causal else None
         return attention(q, k, v, mask)

@@ -59,6 +59,24 @@ def test_flash_fallback_small_shapes():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
 
 
+def test_flash_fallback_warns_at_long_context():
+    """A silent dense fallback at long S turns a shape mistake into an
+    opaque 16 GB OOM (r5, measured on v5e) — it must warn at trace time.
+    Short sequences stay silent."""
+    import warnings
+
+    import pytest
+
+    q, k, v = _qkv(8192, dim=64)     # head_dim 64: untileable on purpose
+    with pytest.warns(UserWarning, match="DENSE attention at S=8192"):
+        jax.eval_shape(lambda q, k, v: flash_attention(q, k, v), q, k, v)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        q2, k2, v2 = _qkv(16, dim=32)
+        flash_attention(q2, k2, v2)      # short fallback: stays silent
+    assert not [w for w in caught if "DENSE attention" in str(w.message)]
+
+
 def test_llama_use_flash_config():
     """tiny preset (head_dim 16) routes through the fallback — forward must
     be identical with the flag on."""

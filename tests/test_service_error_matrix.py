@@ -1,0 +1,162 @@
+"""Outbound HTTP service client error matrix (VERDICT r4 missing #3:
+deepen thin seams — what the client does when the upstream misbehaves,
+across transport failure / slow upstream / 5xx / odd bodies)."""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from gofr_tpu.service import new_http_service
+from gofr_tpu.service.client import ServiceError
+
+
+class _Awkward(BaseHTTPRequestHandler):
+    """Upstream that can stall, 500, or return non-JSON."""
+    mode = "ok"
+
+    def _serve(self):
+        if _Awkward.mode == "slow":
+            time.sleep(3.0)
+        if _Awkward.mode == "error":
+            self.send_response(503)
+            self.send_header("Retry-After", "1")
+            self.end_headers()
+            self.wfile.write(b'{"oops": true}')
+            return
+        if _Awkward.mode == "not-json":
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.end_headers()
+            self.wfile.write(b"<html>not json</html>")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        self.wfile.write(json.dumps({"ok": True}).encode())
+
+    do_GET = do_POST = _serve
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def awkward(mock_container):
+    _Awkward.mode = "ok"
+    server = HTTPServer(("127.0.0.1", 0), _Awkward)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_connection_refused_raises_service_error(mock_container):
+    """A dead upstream raises ServiceError (never a bare urllib error),
+    records status=error in the histogram, and the caller's next request
+    is unaffected."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()                     # nothing listens here now
+    service = new_http_service(f"http://127.0.0.1:{port}",
+                               mock_container.logger,
+                               mock_container.metrics, service_name="down")
+    with pytest.raises(ServiceError, match="GET"):
+        service.get("x")
+    assert mock_container.metrics.value(
+        "app_http_service_response", service="down", method="GET",
+        status="error") == 1
+
+
+def test_upstream_5xx_is_a_response_not_an_exception(mock_container,
+                                                     awkward):
+    """Non-2xx is still a ServiceResponse (the reference returns the
+    *resp* for the caller to inspect) with the real status label in
+    metrics and the body preserved."""
+    _Awkward.mode = "error"
+    service = new_http_service(awkward, mock_container.logger,
+                               mock_container.metrics, service_name="up")
+    response = service.get("x")
+    assert response.status_code == 503
+    assert not response.ok
+    assert response.json() == {"oops": True}
+    assert response.headers.get("Retry-After") == "1"
+    assert mock_container.metrics.value(
+        "app_http_service_response", service="up", method="GET",
+        status="503") == 1
+
+
+def test_timeout_raises_service_error(mock_container, awkward):
+    _Awkward.mode = "slow"
+    service = new_http_service(awkward, mock_container.logger,
+                               mock_container.metrics, service_name="slow",
+                               timeout=0.3)
+    start = time.perf_counter()
+    with pytest.raises(ServiceError):
+        service.get("x")
+    assert time.perf_counter() - start < 2.0   # cut at ~0.3s, not 3s
+
+
+def test_non_json_body_survives_and_json_accessor_raises(mock_container,
+                                                         awkward):
+    _Awkward.mode = "not-json"
+    service = new_http_service(awkward, mock_container.logger,
+                               mock_container.metrics, service_name="up")
+    response = service.get("x")
+    assert response.status_code == 200
+    assert b"<html>" in response.body
+    with pytest.raises(ValueError):
+        response.json()
+
+
+def test_async_verbs_offload_and_match_sync(mock_container, awkward):
+    """aget/apost run the blocking client in the executor and must return
+    the same responses the sync verbs do (handlers await them on the
+    event loop)."""
+    service = new_http_service(awkward, mock_container.logger,
+                               mock_container.metrics, service_name="up")
+
+    async def main():
+        get_resp, post_resp = await asyncio.gather(
+            service.aget("a"), service.apost("b", body={"k": 1}))
+        assert get_resp.json() == {"ok": True}
+        assert post_resp.status_code == 200
+
+    asyncio.run(main())
+
+
+def test_bytes_body_sent_verbatim(mock_container):
+    """A bytes body must pass through untouched (no JSON encoding, no
+    forced content type) — the classify-image path depends on it."""
+    captured = {}
+
+    class Capture(BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            captured["body"] = self.rfile.read(length)
+            captured["content_type"] = self.headers.get("Content-Type")
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *args):
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), Capture)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        service = new_http_service(
+            f"http://127.0.0.1:{server.server_port}",
+            mock_container.logger, mock_container.metrics,
+            service_name="up")
+        payload = bytes(range(256))
+        service.post("raw", body=payload)
+        assert captured["body"] == payload
+        assert captured["content_type"] != "application/json"
+    finally:
+        server.shutdown()
