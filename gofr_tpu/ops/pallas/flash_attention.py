@@ -146,17 +146,22 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     tileable = (seq_len % block_q == 0 and seq_len % block_k == 0
                 and head_dim % 128 == 0 and seq_len >= 128)
     if not tileable:
-        if seq_len >= 8192:
+        # the dense path materializes a (B, H, S, S) score tensor: falling
+        # back *silently* turns a shape mistake into an opaque device OOM
+        # (r5: 16 GB at B=1,H=8,S=32K). Warn whenever that tensor alone
+        # would exceed ~2 GB — it scales with batch and heads, not S only.
+        score_bytes = q.shape[0] * q.shape[2] * seq_len * seq_len \
+            * q.dtype.itemsize
+        if score_bytes > 2 * 1024**3:
             import warnings
 
-            # the dense path materializes an S x S score matrix (16 GB
-            # bf16 at S=32K): falling back *silently* at long context
-            # turns a shape mistake into an opaque device OOM (r5)
             warnings.warn(
-                f"flash_attention falling back to DENSE attention at "
-                f"S={seq_len} (untileable: head_dim {head_dim} must be a "
-                f"multiple of 128 and S divisible by the block sizes) — "
-                f"the S x S score matrix may exceed HBM", stacklevel=2)
+                f"flash_attention falling back to DENSE attention with a "
+                f"{score_bytes / 2**30:.1f} GB score tensor "
+                f"(B={q.shape[0]}, H={q.shape[2]}, S={seq_len}; "
+                f"untileable: head_dim {head_dim} must be a multiple of "
+                f"128 and S divisible by the block sizes) — this may "
+                f"exceed HBM", stacklevel=2)
         from gofr_tpu.ops.attention import attention, causal_mask
         mask = causal_mask(seq_len)[None, None, None] if causal else None
         return attention(q, k, v, mask)

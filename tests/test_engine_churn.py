@@ -1,0 +1,121 @@
+"""Cancellation-churn soak: the engine must survive a storm of streams
+being abandoned at random points — slots recycle, survivors' tokens stay
+exact, and the engine keeps serving afterwards (serving-robustness seam
+on top of tests/test_generate_engine.py's single-cancel case)."""
+
+import asyncio
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.tpu.generate import GenerationEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    # fp32: greedy identity vs the batch-1 reference is the assertion,
+    # and tiny-model bf16 logits produce EXACT argmax ties (measured:
+    # two tokens both at 2.5) that flip with batch shape — a tie-flip is
+    # not the slot-recycling corruption this test hunts
+    import jax.numpy as jnp
+    cfg = llama.config("tiny", dtype=jnp.float32)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_cancellation_storm_recycles_slots_and_keeps_tokens_exact(setup):
+    cfg, params = setup
+    rng = random.Random(7)
+
+    async def main():
+        container = new_mock_container()
+        engine = GenerationEngine(cfg, params, max_slots=4, max_len=64,
+                                  prompt_buckets=(8,), steps_per_tick=4,
+                                  logger=container.logger,
+                                  metrics=container.metrics)
+        await engine.start()
+        try:
+            async def one(i):
+                prompt = [i % 13 + 1, i % 7 + 1]
+                stream = await engine.generate_stream(prompt,
+                                                      max_new_tokens=12)
+                if i % 3 == 0:
+                    # abandon before consuming anything (the HTTP
+                    # never-started-response path)
+                    stream.cancel()
+                    return None
+                got = []
+                cut = rng.randint(2, 10) if i % 3 == 1 else None
+                async for token in stream:
+                    got.append(token)
+                    if cut is not None and len(got) >= cut:
+                        stream.cancel()
+                        return ("cut", got)
+                return ("full", prompt, got)
+
+            results = await asyncio.wait_for(
+                asyncio.gather(*[one(i) for i in range(24)]), 240.0)
+
+            # survivors must be token-exact vs the fused reference
+            for result in results:
+                if result and result[0] == "full":
+                    _, prompt, got = result
+                    ref = llama.generate(params, cfg,
+                                         np.asarray([prompt], np.int32),
+                                         12)
+                    assert got == [int(t) for t in np.asarray(ref)[0]]
+            full = sum(1 for r in results if r and r[0] == "full")
+            cut = sum(1 for r in results if r and r[0] == "cut")
+            assert full and cut          # the storm exercised both paths
+
+            # every slot recycled; the engine still serves
+            assert engine.stats()["free_slots"] == 4
+            out = await asyncio.wait_for(
+                engine.generate([3, 2, 1], max_new_tokens=4), 60.0)
+            assert len(out) == 4
+        finally:
+            await engine.stop()
+    asyncio.run(main())
+
+
+def test_cancel_storm_interleaved_with_plain_generates(setup):
+    """Streams being torn down must never corrupt concurrent plain
+    generate() calls sharing the same ticks."""
+    cfg, params = setup
+
+    async def main():
+        container = new_mock_container()
+        engine = GenerationEngine(cfg, params, max_slots=4, max_len=64,
+                                  prompt_buckets=(8,), steps_per_tick=2,
+                                  logger=container.logger,
+                                  metrics=container.metrics)
+        await engine.start()
+        try:
+            async def victim():
+                stream = await engine.generate_stream([9, 9],
+                                                      max_new_tokens=30)
+                count = 0
+                async for _ in stream:
+                    count += 1
+                    if count == 3:
+                        stream.cancel()
+                        return
+
+            async def survivor(i):
+                prompt = [i + 1, i + 2, i + 3]
+                out = await engine.generate(prompt, max_new_tokens=8)
+                ref = llama.generate(params, cfg,
+                                     np.asarray([prompt], np.int32), 8)
+                assert out == [int(t) for t in np.asarray(ref)[0]], i
+
+            await asyncio.wait_for(asyncio.gather(
+                victim(), survivor(0), victim(), survivor(1),
+                survivor(2)), 240.0)
+            assert engine.stats()["free_slots"] == 4
+        finally:
+            await engine.stop()
+    asyncio.run(main())

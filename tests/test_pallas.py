@@ -67,8 +67,9 @@ def test_flash_fallback_warns_at_long_context():
 
     import pytest
 
-    q, k, v = _qkv(8192, dim=64)     # head_dim 64: untileable on purpose
-    with pytest.warns(UserWarning, match="DENSE attention at S=8192"):
+    q, k, v = _qkv(8192, q_heads=8, dim=64)  # head_dim 64: untileable
+    # B=2 x H=8 x 8192^2 x f32 = 4.3 GB score tensor -> must warn
+    with pytest.warns(UserWarning, match="GB score tensor"):
         jax.eval_shape(lambda q, k, v: flash_attention(q, k, v), q, k, v)
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")

@@ -46,7 +46,7 @@ class _Awkward(BaseHTTPRequestHandler):
 
 
 @pytest.fixture()
-def awkward(mock_container):
+def awkward():
     _Awkward.mode = "ok"
     server = HTTPServer(("127.0.0.1", 0), _Awkward)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
