@@ -36,6 +36,7 @@ import asyncio
 import json
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -226,7 +227,7 @@ def _relay_floor_bench() -> dict:
 
 
 def _chained_device_latency(make_step, params, x, batch: int,
-                            reps: int = 5):
+                            reps: int = 5, n: Optional[int] = None):
     """Device-attributable latency of one model step, measured by
     iterating the step N times INSIDE one executable (``lax.fori_loop``
     with an unfoldable inter-iteration dependency) and fetching a scalar.
@@ -259,8 +260,10 @@ def _chained_device_latency(make_step, params, x, batch: int,
 
     # iterate enough that the signal dwarfs round-trip jitter (a floor of
     # 8 let a lucky rep read batch-256 ResNet at 11 ms vs its true ~20 —
-    # spread 1.0 flagged it), bounded so big batches stay ~1 s per rep
-    n = max(24, min(128, 2048 // max(1, batch)))
+    # spread 1.0 flagged it), bounded so big batches stay ~1 s per rep.
+    # Callers timing steps that already run 100s of ms pass ``n`` low.
+    if n is None:
+        n = max(24, min(128, 2048 // max(1, batch)))
     big = chained(n)
     small = chained(2)
     np.asarray(big(params, x))      # warm both executables
@@ -1089,8 +1092,51 @@ def _llama7b_int8_bench(on_tpu: bool):
     device_tok_s = (engine.max_slots * k_steps / device_tick_s
                     if device_tick_s else None)
 
+    # prefill throughput + the 7B TTFT floor: one batched 256-token
+    # prompt forward (pure compute, no cache involvement) timed with the
+    # in-executable chain. This is where the MXU earns its keep — and
+    # the prompt-processing latency an operator adds to one decode tick
+    # to get time-to-first-token at 7B scale.
+    prefill_bucket, prefill_nb = 256, 8
+    prefill_fn = engine._prefill_fn(prefill_nb, prefill_bucket)
+
+    def prefill_step(p, toks, eps):
+        lengths = jnp.full((prefill_nb,), prefill_bucket, jnp.int32)
+        zeros_f = jnp.zeros((prefill_nb,), jnp.float32)
+        zeros_i = jnp.zeros((prefill_nb,), jnp.int32)
+        ones_f = jnp.ones((prefill_nb,), jnp.float32)
+        seeds = jnp.zeros((prefill_nb,), jnp.uint32)
+        first, _small, _keys = prefill_fn(
+            p, toks + eps.astype(jnp.int32), lengths, zeros_f, zeros_i,
+            ones_f, seeds)
+        return first
+    prompt_toks = jnp.ones((prefill_nb, prefill_bucket), jnp.int32)
+    prefill_lat, _spread = _chained_device_latency(
+        prefill_step, params, prompt_toks, prefill_nb * prefill_bucket,
+        reps=3, n=6)    # a ~27-TFLOP step: 6 iterations already ~1.5 s
+    prefill = None
+    if prefill_lat:
+        prefill_tokens = prefill_nb * prefill_bucket
+        # 2 FLOPs per param per token (weights dominate at 7B)
+        prefill_flops = 2.0 * 6.7e9 * prefill_tokens
+        peak = PEAK_BF16.get(jax.devices()[0].device_kind)
+        prefill = {
+            "bucket": prefill_bucket, "batch": prefill_nb,
+            "device_latency_ms": round(prefill_lat * 1e3, 2),
+            "prompt_tok_s": round(prefill_tokens / prefill_lat, 1),
+            "mfu_est": round(prefill_flops / prefill_lat / peak, 3)
+            if peak else None,
+            "ttft_floor_ms": round(
+                (prefill_lat + (device_tick_s or 0) / k_steps) * 1e3, 2),
+            "note": ("ttft_floor = one batched 256-token prefill + one "
+                     "decode step at the operating point; real TTFT adds "
+                     "admission wait (measured at llama-small scale in "
+                     "llama_small_decode.ttft_under_load)"),
+        }
+
     roofline = engine.max_slots * hbm_bw / step_bytes
     return {"decode_tok_s": round(tok_s, 1),
+            "prefill": prefill,
             "roofline_tok_s": round(roofline, 1),
             "roofline_frac": round(tok_s / roofline, 3),
             "device_only_tok_s": round(device_tok_s, 1)

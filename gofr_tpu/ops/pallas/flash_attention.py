@@ -149,9 +149,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # the dense path materializes a (B, H, S, S) score tensor: falling
         # back *silently* turns a shape mistake into an opaque device OOM
         # (r5: 16 GB at B=1,H=8,S=32K). Warn whenever that tensor alone
-        # would exceed ~2 GB — it scales with batch and heads, not S only.
-        score_bytes = q.shape[0] * q.shape[2] * seq_len * seq_len \
-            * q.dtype.itemsize
+        # would exceed ~2 GB — it scales with batch and heads, not S
+        # only. Scores/softmax accumulate in fp32 regardless of input
+        # dtype (ops/attention.py), so size at 4 bytes per element.
+        score_bytes = q.shape[0] * q.shape[2] * seq_len * seq_len * 4
         if score_bytes > 2 * 1024**3:
             import warnings
 
