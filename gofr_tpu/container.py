@@ -131,6 +131,11 @@ class Container:
         metrics.new_counter("app_tpu_requests_total", "TPU predict requests")
         metrics.new_gauge("app_tpu_attention_window",
                           "decode attention window rung (fill-bounded)")
+        metrics.new_histogram(
+            "app_tpu_ttft",
+            "time to first generated token (s): admission wait + prefill "
+            "(the first token is sampled inside the prefill executable)",
+            (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0))
 
     # -- outbound services (container.go:150-152) ---------------------------
     def add_http_service(self, name: str, service: Any) -> None:

@@ -50,6 +50,7 @@ executable ladders are warm.
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple, Union
 
@@ -138,10 +139,11 @@ class TokenStream:
 
 class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
-                 "inflight", "queue", "temperature", "fill")
+                 "inflight", "queue", "temperature", "fill", "submitted_at")
 
     def __init__(self):
         self.future: Optional[asyncio.Future] = None
+        self.submitted_at = 0.0    # request submit time → TTFT histogram
         self.remaining = 0
         self.eos_id: Optional[int] = None
         self.tokens: List[int] = []
@@ -567,7 +569,8 @@ class GenerationEngine:
         prompt, bucket = self._validate(prompt_ids, max_new_tokens)
         future = asyncio.get_running_loop().create_future()
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
-                                 sampling or Sampling(), future, None))
+                                 sampling or Sampling(), future, None,
+                                 time.monotonic()))
         self._wake.set()
         return await future
 
@@ -590,7 +593,8 @@ class GenerationEngine:
         queue: asyncio.Queue = asyncio.Queue()
         future = asyncio.get_running_loop().create_future()
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
-                                 sampling or Sampling(), future, queue))
+                                 sampling or Sampling(), future, queue,
+                                 time.monotonic()))
         self._wake.set()
         return TokenStream(self, queue, future)
 
@@ -787,8 +791,8 @@ class GenerationEngine:
         jnp = self._jnp
         fetches: List[Tuple[Any, List[Tuple[int, int, int]]]] = []
         by_bucket: Dict[int, List[Tuple]] = {}
-        for prompt, bucket, budget, eos_id, sampling, future, queue \
-                in requests:
+        for prompt, bucket, budget, eos_id, sampling, future, queue, \
+                submitted_at in requests:
             if queue is not None and queue in self._cancelled_queues:
                 # stream consumer vanished before admission: drop it
                 self._cancelled_queues.discard(queue)
@@ -796,7 +800,8 @@ class GenerationEngine:
                     future.cancel()
                 continue
             by_bucket.setdefault(bucket, []).append(
-                (prompt, budget, eos_id, sampling, future, queue))
+                (prompt, budget, eos_id, sampling, future, queue,
+                 submitted_at))
         if self._pending.empty():
             # no queued request can match a leftover entry any more —
             # bound the set (cancel-after-completion would otherwise leak)
@@ -816,11 +821,12 @@ class GenerationEngine:
             top_ps = np.ones((nb,), np.float32)
             seeds = np.zeros((nb,), np.uint32)
             claimed: List[Tuple[int, int, int]] = []          # (slot,gen,row)
-            for row, (prompt, budget, eos_id, sampling, future, queue) \
-                    in enumerate(group):
+            for row, (prompt, budget, eos_id, sampling, future, queue,
+                      submitted_at) in enumerate(group):
                 slot_idx = self._free.pop()
                 slot = self._slots[slot_idx]
                 slot.future = future
+                slot.submitted_at = submitted_at
                 slot.remaining = budget
                 slot.eos_id = eos_id
                 slot.tokens = []
@@ -954,6 +960,14 @@ class GenerationEngine:
         slot.inflight -= len(tokens)
         if not slot.active:
             return
+        if not slot.tokens and self.metrics is not None:
+            # first published token for this request: submit → now is the
+            # operator-facing TTFT — admission wait + prefill dispatch +
+            # fetch (the first token is sampled in the prefill executable,
+            # so no decode tick is included)
+            self.metrics.record_histogram(
+                "app_tpu_ttft", time.monotonic() - slot.submitted_at,
+                model="generate")
         for token in tokens:
             slot.tokens.append(token)
             slot.remaining -= 1

@@ -177,6 +177,40 @@ def test_mesh_engine_matches_single_device(setup):
     asyncio.run(main())
 
 
+def test_ttft_histogram_recorded_per_request(setup):
+    """Every request's time-to-first-token (admission wait + prefill —
+    the first token is sampled in the prefill executable) lands in
+    app_tpu_ttft — the operator-facing TTFT signal (r5; previously only
+    the bench measured TTFT, externally)."""
+    cfg, params = setup
+
+    async def main():
+        container = new_mock_container()
+        engine = GenerationEngine(cfg, params, max_slots=2, max_len=64,
+                                  prompt_buckets=(8,),
+                                  logger=container.logger,
+                                  metrics=container.metrics)
+        await engine.start()
+        try:
+            await asyncio.wait_for(asyncio.gather(*[
+                engine.generate([i + 1, i + 2], max_new_tokens=3)
+                for i in range(3)]), 120.0)
+            count = container.metrics.value("app_tpu_ttft",
+                                            model="generate")
+            assert count == 3, count
+            # streamed requests record it too (on first published token)
+            stream = await engine.generate_stream([5, 6],
+                                                  max_new_tokens=2)
+            async for _ in stream:
+                break
+            stream.cancel()
+            assert container.metrics.value("app_tpu_ttft",
+                                           model="generate") == 4
+        finally:
+            await engine.stop()
+    asyncio.run(main())
+
+
 def test_engine_warmup_precompiles(setup):
     cfg, params = setup
 
