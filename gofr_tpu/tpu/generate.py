@@ -447,7 +447,7 @@ class GenerationEngine:
           scale.
         - ``"all"``: the full ladder (opt-in full-matrix warmup).
         - an explicit tuple: exactly those rungs. Every entry must be a
-          ladder rung (``engine_stats()["window_ladder"]`` lists them,
+          ladder rung (``stats()["window_ladder"]`` lists them,
           with ``None`` spelled as max_len) — a silent mismatch would warm
           nothing and push compilation onto the first serving tick.
 
