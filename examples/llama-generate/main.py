@@ -64,8 +64,12 @@ def build_app():
         # decode ticks in flight before the oldest fetch must land: token
         # fetches overlap device compute and each other (D2H pipelining)
         max_inflight_ticks=int(os.environ.get("INFLIGHT_TICKS", "4")),
-        logger=app.logger, metrics=app.container.metrics)
+        logger=app.logger, metrics=app.container.metrics,
+        # flight recorder: queue.wait/prefill/decode child spans per
+        # request, engine-step spans with links, /debug/statusz timelines
+        tracer=app.container.tracer)
     app.container.tpu = engine  # surfaces engine health under /.well-known
+    app.enable_statusz()        # live queue/slot/KV-cache/timeline snapshot
 
     @app.on_startup
     async def warm_engine():

@@ -216,6 +216,11 @@ class App:
         from gofr_tpu.profiler import enable_profiler
         enable_profiler(self, prefix)
 
+    # -- flight recorder statusz (no reference analog; statusz.py) ----------
+    def enable_statusz(self, prefix: str = "/debug/statusz") -> None:
+        from gofr_tpu.statusz import enable_statusz
+        enable_statusz(self, prefix)
+
     # -- external DB injection (externalDB.go:5-39) -------------------------
     def add_mongo(self, client=None) -> None:
         if client is None:
@@ -348,7 +353,7 @@ class App:
                 self.container.tpu,
                 max_batch=self.config.get_int("TPU_MAX_BATCH", 32),
                 max_delay_ms=self.config.get_float("TPU_BATCH_DELAY_MS", 2.0),
-                logger=self.logger)
+                logger=self.logger, tracer=self.container.tracer)
 
         self._metrics_server = HTTPServer(
             self._metrics_dispatch, self.metrics_port, logger=self.logger)

@@ -185,6 +185,12 @@ class Container:
                         await result
                 except Exception:
                     pass
+        # flush spans finished during shutdown (tracer.shutdown drains the
+        # export queue before closing the exporter)
+        try:
+            self.tracer.shutdown()
+        except Exception:
+            pass
 
 
 def new_mock_container(config: Optional[Dict[str, str]] = None) -> Container:

@@ -25,6 +25,10 @@ def tracing_middleware(tracer: Tracer) -> Middleware:
                 span.set_attribute("http.status_code", status)
                 if status >= 500:
                     span.set_status("ERROR")
+                # clients/operators can join logs, exemplars, and the
+                # flight recorder on this id without parsing traceparent
+                headers = dict(headers or {})
+                headers.setdefault("X-Trace-Id", span.trace_id)
                 return status, headers, body
         return handle
     return middleware

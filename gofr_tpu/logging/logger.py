@@ -92,9 +92,10 @@ class Logger:
                     + f".{int((time.time() % 1) * 1e6):06d}Z",
             "message": message,
         }
-        trace_id = _current_trace_id()
+        trace_id, span_id = _current_trace_ids()
         if trace_id:
             entry["trace_id"] = trace_id
+            entry["span_id"] = span_id
         if fields:
             entry.update(fields)
         with self._lock:
@@ -116,7 +117,8 @@ class Logger:
             head += f"\033[90m{entry['trace_id']}\033[0m "
         stream.write(head + str(entry["message"]))
         extras = {k: v for k, v in entry.items()
-                  if k not in ("level", "time", "message", "trace_id")}
+                  if k not in ("level", "time", "message", "trace_id",
+                               "span_id")}
         if extras:
             stream.write(" " + json.dumps(extras, default=str))
         stream.write("\n")
@@ -155,14 +157,19 @@ def _jsonable(obj: Any) -> Any:
     return obj
 
 
-def _current_trace_id() -> Optional[str]:
+def _current_trace_ids() -> "tuple[Optional[str], Optional[str]]":
+    """(trace_id, span_id) of the active span — every log line written
+    under a span is joinable against the trace store and the flight
+    recorder's request timelines."""
     # Imported lazily to avoid a circular dependency logging <-> trace.
     try:
         from gofr_tpu.trace.tracer import current_span
         span = current_span()
-        return span.trace_id if span is not None else None
+        if span is None:
+            return None, None
+        return span.trace_id, span.span_id
     except Exception:
-        return None
+        return None, None
 
 
 def new_logger(level: Level = Level.INFO) -> Logger:

@@ -37,6 +37,15 @@ def _fmt_float(value: float) -> str:
     return repr(float(value))
 
 
+def _fmt_exemplar(exemplar) -> str:
+    """OpenMetrics exemplar suffix: `` # {trace_id="..."} value timestamp``
+    (OpenMetrics 1.0 §exemplars). Appended to ``_bucket`` sample lines so a
+    latency histogram links back to one concrete traced request."""
+    labels, value, ts = exemplar
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return f" # {{{inner}}} {_fmt_float(value)} {ts:.3f}"
+
+
 def render_prometheus(manager: "Manager") -> str:
     lines = []
     for name, metric in sorted(manager.snapshot().items()):
@@ -47,19 +56,27 @@ def render_prometheus(manager: "Manager") -> str:
         if metric.kind == "histogram":
             for key, state in sorted(metric.series.items()):
                 assert isinstance(state, dict)
+                exemplars = state.get("exemplars", {})
                 cumulative = 0
-                for bound, count in zip(metric.buckets, state["buckets"]):
+                for i, (bound, count) in enumerate(
+                        zip(metric.buckets, state["buckets"])):
                     cumulative += count
                     le_labels = dict(key)
                     le_labels["le"] = _fmt_float(bound)
-                    lines.append(
+                    line = (
                         f"{name}_bucket{_fmt_labels(tuple(sorted(le_labels.items())))} {cumulative}"
                     )
+                    if i in exemplars:
+                        line += _fmt_exemplar(exemplars[i])
+                    lines.append(line)
                 inf_labels = dict(key)
                 inf_labels["le"] = "+Inf"
-                lines.append(
+                line = (
                     f"{name}_bucket{_fmt_labels(tuple(sorted(inf_labels.items())))} {state['count']}"
                 )
+                if len(metric.buckets) in exemplars:
+                    line += _fmt_exemplar(exemplars[len(metric.buckets)])
+                lines.append(line)
                 lines.append(f"{name}_sum{_fmt_labels(key)} {_fmt_float(state['sum'])}")
                 lines.append(f"{name}_count{_fmt_labels(key)} {state['count']}")
         else:

@@ -112,7 +112,14 @@ class Manager:
             self._check_cardinality(metric)
             metric.series[key] = float(metric.series.get(key, 0.0)) + value  # type: ignore[arg-type]
 
-    def record_histogram(self, name: str, value: float, /, **labels: str) -> None:
+    def record_histogram(self, name: str, value: float, /,
+                         exemplar: Optional[Dict[str, str]] = None,
+                         **labels: str) -> None:
+        """Record one observation. ``exemplar`` is an optional small label
+        dict (typically ``{"trace_id": ...}``) attached to the bucket the
+        value falls into and rendered as an OpenMetrics exemplar — the
+        bridge from an aggregate latency histogram back to one concrete
+        traced request."""
         metric = self._get(name, "histogram")
         if metric is None:
             return
@@ -122,15 +129,24 @@ class Manager:
             state = metric.series.get(key)
             if state is None:
                 state = {"count": 0, "sum": 0.0,
-                         "buckets": [0] * len(metric.buckets)}
+                         "buckets": [0] * len(metric.buckets),
+                         "exemplars": {}}
                 metric.series[key] = state
             state["count"] += 1          # type: ignore[index]
             state["sum"] += value        # type: ignore[index]
             # per-bucket counts; exposition cumulates (prometheus `le` form)
+            bucket_idx = len(metric.buckets)   # +Inf bucket
             for i, bound in enumerate(metric.buckets):
                 if value <= bound:
                     state["buckets"][i] += 1  # type: ignore[index]
+                    bucket_idx = i
                     break
+            if exemplar:
+                # last observation wins per bucket (OpenMetrics allows at
+                # most one exemplar per bucket line)
+                state.setdefault("exemplars", {})[bucket_idx] = (  # type: ignore[union-attr]
+                    {str(k): str(v) for k, v in exemplar.items()},
+                    float(value), time.time())
 
     def set_gauge(self, name: str, value: float, /, **labels: str) -> None:
         metric = self._get(name, "gauge")
@@ -172,6 +188,18 @@ def new_manager(logger: Optional[Logger] = None) -> Manager:
     return Manager(logger=logger)
 
 
+def current_rss_bytes() -> Optional[float]:
+    """Current (not peak) resident set size from ``/proc/self/statm``;
+    None where procfs is unavailable (macOS, restricted containers)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            resident_pages = int(fh.read().split()[1])
+        import os
+        return float(resident_pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 def system_metrics_refresh(manager: Manager, app_name: str, app_version: str) -> None:
     """Refresh runtime gauges; called on each scrape (reference:
     metrics/handler.go:21-35 and container/container.go:158-166 app_info /
@@ -181,8 +209,13 @@ def system_metrics_refresh(manager: Manager, app_name: str, app_version: str) ->
 
     manager.set_gauge("app_info", 1.0, name=app_name, version=app_version)
     manager.set_gauge("threads_total", float(threading.active_count()))
+    # ru_maxrss is the PEAK rss — a gauge built from it can never go down
+    # and overstates steady-state memory; prefer the live value from procfs
+    rss = current_rss_bytes()
     usage = resource.getrusage(resource.RUSAGE_SELF)
-    manager.set_gauge("memory_rss_bytes", float(usage.ru_maxrss) * 1024.0)
+    if rss is None:
+        rss = float(usage.ru_maxrss) * 1024.0
+    manager.set_gauge("memory_rss_bytes", rss)
     manager.set_gauge("gc_objects", float(gc.get_count()[0]))
     manager.set_gauge("uptime_seconds", time.monotonic() - _START)
 

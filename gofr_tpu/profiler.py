@@ -9,37 +9,43 @@ framework wires jax.profiler behind two admin routes:
 
 The captured directory is TensorBoard/XProf-compatible. Routes are only
 registered via ``app.enable_profiler()`` — never on by default.
+
+State is per-``enable_profiler`` call (i.e. per App), not module-global:
+two App instances in one process (tests, embedded apps) must not see each
+other's profiling session through a shared dict. jax.profiler itself is
+process-wide, so concurrent *starts* from two apps still race at the JAX
+layer — but one app stopping can no longer clobber another's bookkeeping.
 """
 
 from __future__ import annotations
 
 import threading
 
-_state = {"dir": None}
-_lock = threading.Lock()
-
 
 def enable_profiler(app, prefix: str = "/debug/profiler") -> None:
+    state = {"dir": None}
+    lock = threading.Lock()
+
     def start(ctx):
         import jax
         body = ctx.bind() or {}
         trace_dir = body.get("dir") or "/tmp/gofr_tpu_trace"
-        with _lock:
-            if _state["dir"] is not None:
+        with lock:
+            if state["dir"] is not None:
                 return {"status": "already profiling",
-                        "dir": _state["dir"]}
+                        "dir": state["dir"]}
             jax.profiler.start_trace(trace_dir)
-            _state["dir"] = trace_dir
+            state["dir"] = trace_dir
         ctx.logger.info("profiler started -> %s", trace_dir)
         return {"status": "started", "dir": trace_dir}
 
     def stop(ctx):
         import jax
-        with _lock:
-            if _state["dir"] is None:
+        with lock:
+            if state["dir"] is None:
                 return {"status": "not profiling"}
             jax.profiler.stop_trace()
-            trace_dir, _state["dir"] = _state["dir"], None
+            trace_dir, state["dir"] = state["dir"], None
         ctx.logger.info("profiler stopped, trace in %s", trace_dir)
         return {"status": "stopped", "dir": trace_dir}
 

@@ -1,0 +1,63 @@
+"""Live serving-state snapshot over HTTP: ``/debug/statusz``.
+
+The flight recorder's admin surface (ISSUE 1). Where ``/metrics`` exposes
+aggregates and ``/debug/profiler`` captures device traces, ``statusz``
+answers the on-call question "what is the server doing *right now*": the
+batcher's pending queue depths, the generation engine's admission queue and
+per-slot states, KV-cache occupancy, per-device health gauges, and the
+last-N request timelines (queue wait, TTFT, tokens/s, batch sizes ridden).
+
+Registered like the profiler — ``app.enable_statusz()`` — never on by
+default. Everything rendered is host-side bookkeeping: no device syncs, so
+hitting the endpoint cannot perturb serving latency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def build_status(app, recent: int = 32) -> Dict[str, Any]:
+    """Assemble the statusz snapshot from whatever serving pieces the app
+    actually wired: Executor and GenerationEngine both duck-type via
+    ``health_check``/``statusz``; absent pieces are simply omitted."""
+    container = app.container
+    status: Dict[str, Any] = {
+        "app": {
+            "name": container.app_name,
+            "version": container.app_version,
+        },
+    }
+
+    batcher = getattr(container, "tpu_batcher", None)
+    if batcher is not None:
+        status["batcher"] = {
+            "max_batch": batcher.max_batch,
+            "max_delay_ms": batcher.max_delay * 1000.0,
+            "queue_depths": batcher.queue_depths(),
+        }
+
+    tpu = container.tpu
+    if tpu is not None:
+        statusz_fn = getattr(tpu, "statusz", None)
+        if statusz_fn is not None:      # GenerationEngine
+            status["engine"] = statusz_fn(recent=recent)
+        health_fn = getattr(tpu, "health_check", None)
+        if health_fn is not None:       # device liveness + HBM gauges
+            status["devices"] = health_fn()
+        recorder = getattr(tpu, "recorder", None)
+        if recorder is not None and "engine" not in status:
+            status["requests"] = recorder.snapshot(limit=recent)
+
+    return status
+
+
+def enable_statusz(app, prefix: str = "/debug/statusz") -> None:
+    def statusz(ctx):
+        try:
+            recent = int(ctx.param("recent") or 32)
+        except (TypeError, ValueError):
+            recent = 32
+        return build_status(app, recent=max(1, min(recent, 256)))
+
+    app.get(prefix, statusz)

@@ -5,7 +5,9 @@ CPU backend in tests — the "miniredis of XLA" strategy (SURVEY.md §4)."""
 
 from gofr_tpu.tpu.batcher import DynamicBatcher
 from gofr_tpu.tpu.executor import DEFAULT_BUCKETS, Executor, new_executor
+from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.tpu.generate import GenerationEngine
 
-__all__ = ["DynamicBatcher", "Executor", "GenerationEngine", "new_executor",
+__all__ = ["DynamicBatcher", "Executor", "FlightRecorder",
+           "GenerationEngine", "RequestRecord", "new_executor",
            "DEFAULT_BUCKETS"]

@@ -14,32 +14,43 @@ batches: batch N on device while batch N+1 accumulates. Composes with the
 per-request timeout/panic isolation the handler layer guarantees
 (reference semantics: /root/reference/pkg/gofr/handler.go:63-92): a
 request future that is cancelled simply never gets its slice.
+
+Flight-recorder integration (ISSUE 1): each request's span gets a
+``queue.wait`` child covering submit → flush, and every flushed batch runs
+under one ``tpu.batch`` step span carrying span links to all coalesced
+requests — the many-to-one edge a parent/child tree cannot express. The
+executor stamps the step's exemplar trace onto ``app_tpu_execute``.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextvars
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gofr_tpu.trace import Span, current_span
+
 
 class _Pending:
-    __slots__ = ("examples", "futures", "timer")
+    __slots__ = ("examples", "futures", "spans", "timer")
 
     def __init__(self):
         self.examples: List[Any] = []
         self.futures: List[asyncio.Future] = []
+        self.spans: List[Optional[Span]] = []   # queue.wait span per example
         self.timer: Optional[asyncio.TimerHandle] = None
 
 
 class DynamicBatcher:
     def __init__(self, executor, max_batch: int = 32,
-                 max_delay_ms: float = 2.0, logger=None):
+                 max_delay_ms: float = 2.0, logger=None, tracer=None):
         self.executor = executor
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1000.0
         self.logger = logger
+        self.tracer = tracer
         self._pending: Dict[str, _Pending] = {}
 
     async def predict(self, name: str, example: Any) -> Any:
@@ -47,14 +58,27 @@ class DynamicBatcher:
         loop = asyncio.get_running_loop()
         pending = self._pending.setdefault(name, _Pending())
         future: asyncio.Future = loop.create_future()
+        span = None
+        if self.tracer is not None:
+            # child of the request span: time spent waiting for the batch
+            # to fill/flush, invisible to the HTTP middleware otherwise
+            span = self.tracer.start_span("queue.wait")
+            span.set_attribute("model", name)
         pending.examples.append(example)
         pending.futures.append(future)
+        pending.spans.append(span)
         if len(pending.examples) >= self.max_batch:
             self._flush(name)
         elif pending.timer is None:
             pending.timer = loop.call_later(self.max_delay,
                                             self._flush, name)
         return await future
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Examples currently waiting for a flush, per model — the batcher
+        half of ``/debug/statusz``'s queue-depth view."""
+        return {name: len(p.examples)
+                for name, p in self._pending.items() if p.examples}
 
     def _flush(self, name: str) -> None:
         pending = self._pending.get(name)
@@ -63,29 +87,48 @@ class DynamicBatcher:
         if pending.timer is not None:
             pending.timer.cancel()
         self._pending[name] = _Pending()
-        examples, futures = pending.examples, pending.futures
-        asyncio.ensure_future(self._run(name, examples, futures))
+        for span in pending.spans:
+            if span is not None:
+                span.set_attribute("batch_size", len(pending.examples))
+                span.finish()
+        asyncio.ensure_future(self._run(name, pending.examples,
+                                        pending.futures, pending.spans))
 
     async def _run(self, name: str, examples: List[Any],
-                   futures: List[asyncio.Future]) -> None:
+                   futures: List[asyncio.Future],
+                   spans: List[Optional[Span]]) -> None:
         loop = asyncio.get_running_loop()
+        step_span = None
+        if self.tracer is not None:
+            # root span for the fused device step, linked to every request
+            # it serves (requests share the step — links, not parenthood)
+            step_span = Span(self.tracer, "tpu.batch")
+            step_span.set_attribute("model", name)
+            step_span.set_attribute("batch_size", len(examples))
+            for span in spans:
+                if span is not None:
+                    step_span.add_link(span)
         try:
             import jax
             batch = jax.tree.map(
                 lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
                 *examples)
-            if getattr(self.executor, "is_warm", None) \
-                    and self.executor.is_warm(name, len(examples)):
-                # warm path: enqueue H2D + execute right now on the loop
-                # (both async in JAX), sync off-loop. Batch N+1's transfer
-                # rides under batch N's execute — H2D/compute overlap.
-                handle = self.executor.dispatch(name, batch)
-                result = await loop.run_in_executor(
-                    None, self.executor.fetch, handle)
-            else:
-                # cold path (compile) stays off-loop entirely
-                result = await loop.run_in_executor(
-                    None, self.executor.predict, name, batch)
+            with step_span if step_span is not None else _null_ctx():
+                if getattr(self.executor, "is_warm", None) \
+                        and self.executor.is_warm(name, len(examples)):
+                    # warm path: enqueue H2D + execute right now on the loop
+                    # (both async in JAX), sync off-loop. Batch N+1's transfer
+                    # rides under batch N's execute — H2D/compute overlap.
+                    handle = self.executor.dispatch(name, batch)
+                    result = await loop.run_in_executor(
+                        None, self.executor.fetch, handle)
+                else:
+                    # cold path (compile) stays off-loop entirely; carry the
+                    # step span's context into the worker thread so the
+                    # executor can stamp its exemplar/log trace ids
+                    ctx = contextvars.copy_context()
+                    result = await loop.run_in_executor(
+                        None, ctx.run, self.executor.predict, name, batch)
             for i, future in enumerate(futures):
                 if not future.done():  # request may have timed out/gone
                     future.set_result(
@@ -96,3 +139,11 @@ class DynamicBatcher:
             for future in futures:
                 if not future.done():
                     future.set_exception(exc)
+
+
+class _null_ctx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return None

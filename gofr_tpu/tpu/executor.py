@@ -162,18 +162,25 @@ class Executor:
     def _dispatch(self, model: _Model, name: str, inputs: Any, leaves,
                   n: int, bucket: int):
         start = time.perf_counter()
+        # capture the dispatching context's span (request span, or the
+        # batcher's step span) so fetch — possibly on a worker thread with
+        # no context — can stamp the latency histogram's exemplar
+        from gofr_tpu.trace import current_span
+        span = current_span()
         padded = self._tree_unflatten(
             inputs, [_pad_batch(np.asarray(l), bucket) for l in leaves])
         out = self._execute_async(model, padded, bucket)
-        return (name, out, n, start)
+        return (name, out, n, start, span)
 
     def fetch(self, handle) -> Any:
         """Sync a ``dispatch`` handle: wait for the execute, record metrics,
         slice off the padding."""
-        name, out, n, start = handle
+        name, out, n, start, span = handle
         out = self._jax.block_until_ready(out)
         elapsed = time.perf_counter() - start
-        self.metrics.record_histogram("app_tpu_execute", elapsed, model=name)
+        exemplar = ({"trace_id": span.trace_id} if span is not None else None)
+        self.metrics.record_histogram("app_tpu_execute", elapsed,
+                                      exemplar=exemplar, model=name)
         self.metrics.record_histogram("app_tpu_batch_size", float(n),
                                       model=name)
         self.metrics.increment_counter("app_tpu_requests_total", model=name)

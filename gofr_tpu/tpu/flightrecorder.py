@@ -1,0 +1,157 @@
+"""Request flight recorder: bounded in-memory timeline of recent inference
+requests.
+
+Observability gap this closes (ISSUE 1): a request's trace used to end at
+the HTTP middleware while its latency lived inside the continuous-batching
+engine — queue wait, prefill, per-token decode, and which batches the
+request rode in were invisible. The recorder keeps one compact
+:class:`RequestRecord` per request (in-flight + a bounded ring of completed
+ones) that ``/debug/statusz`` renders live; the batcher/engine additionally
+emit real child spans (``queue.wait`` / ``prefill`` / ``decode``) and
+per-step spans with links, so the same timeline is visible in a trace UI.
+
+Everything here is plain host bookkeeping — no device syncs, O(1) per
+event, bounded memory — so it is always on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class RequestRecord:
+    """Timeline of one request through the serving stack. Timestamps are
+    ``time.monotonic`` (durations); ``wall_enqueued_at`` is ``time.time``
+    for display. Batch participation is kept as bounded aggregates (count /
+    min / max / sum), not a per-tick list — a long generation must not grow
+    the record."""
+
+    __slots__ = ("trace_id", "span_id", "model", "prompt_len", "budget",
+                 "wall_enqueued_at", "enqueued_at", "admitted_at",
+                 "first_token_at", "finished_at", "tokens", "status",
+                 "ticks", "batch_min", "batch_max", "batch_sum")
+
+    def __init__(self, model: str = "generate", prompt_len: int = 0,
+                 budget: int = 0, trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.model = model
+        self.prompt_len = prompt_len
+        self.budget = budget
+        self.wall_enqueued_at = time.time()
+        self.enqueued_at = time.monotonic()
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.tokens = 0
+        self.status = "queued"   # queued|running|done|cancelled|error
+        self.ticks = 0
+        self.batch_min = 0
+        self.batch_max = 0
+        self.batch_sum = 0
+
+    # -- event hooks (engine/batcher call these) ---------------------------
+    def admitted(self) -> None:
+        self.admitted_at = time.monotonic()
+        self.status = "running"
+
+    def rode_batch(self, size: int) -> None:
+        self.ticks += 1
+        self.batch_sum += size
+        self.batch_min = size if self.ticks == 1 else min(self.batch_min, size)
+        self.batch_max = max(self.batch_max, size)
+
+    def first_token(self) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+
+    def finish(self, status: str = "done") -> None:
+        if self.finished_at is None:
+            self.finished_at = time.monotonic()
+            self.status = status
+
+    # -- derived metrics ----------------------------------------------------
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.admitted_at is None:
+            return None
+        return self.admitted_at - self.enqueued_at
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.enqueued_at
+
+    @property
+    def tokens_per_s(self) -> Optional[float]:
+        if self.admitted_at is None or self.tokens == 0:
+            return None
+        end = self.finished_at or time.monotonic()
+        elapsed = end - self.admitted_at
+        return self.tokens / elapsed if elapsed > 0 else None
+
+    def to_dict(self) -> Dict[str, Any]:
+        def _round(value: Optional[float]) -> Optional[float]:
+            return None if value is None else round(value, 6)
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "model": self.model,
+            "status": self.status,
+            "prompt_len": self.prompt_len,
+            "budget": self.budget,
+            "enqueued_at": self.wall_enqueued_at,
+            "queue_wait_s": _round(self.queue_wait_s),
+            "ttft_s": _round(self.ttft_s),
+            "tokens": self.tokens,
+            "tokens_per_s": _round(self.tokens_per_s),
+            "batch_sizes": {
+                "ticks": self.ticks,
+                "min": self.batch_min,
+                "max": self.batch_max,
+                "mean": (round(self.batch_sum / self.ticks, 2)
+                         if self.ticks else None),
+            },
+        }
+
+
+class FlightRecorder:
+    """Bounded ring buffer of completed :class:`RequestRecord` plus the
+    live in-flight set. Lock-guarded: events come from the serving loop,
+    snapshots from the admin endpoint, and batcher fetches from worker
+    threads."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, RequestRecord] = {}
+        self._completed: "deque[RequestRecord]" = deque(maxlen=capacity)
+        self._total = 0
+
+    def start(self, record: RequestRecord) -> RequestRecord:
+        with self._lock:
+            self._total += 1
+            self._inflight[id(record)] = record
+        return record
+
+    def finish(self, record: RequestRecord, status: str = "done") -> None:
+        record.finish(status)
+        with self._lock:
+            if self._inflight.pop(id(record), None) is not None:
+                self._completed.append(record)
+
+    def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        with self._lock:
+            inflight = [r.to_dict() for r in self._inflight.values()]
+            recent = [r.to_dict() for r in self._completed]
+        if limit is not None:
+            recent = recent[-limit:]
+        recent.reverse()   # newest first — the ops-facing order
+        return {"total_requests": self._total,
+                "in_flight": inflight,
+                "recent": recent}

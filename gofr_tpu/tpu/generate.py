@@ -56,6 +56,9 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
+from gofr_tpu.trace import Span, current_span
+
 DEFAULT_PROMPT_BUCKETS = (32, 128, 512)
 
 # sentinel pushed onto a streaming queue when the request completes
@@ -137,9 +140,24 @@ class TokenStream:
         self.cancel()
 
 
+class _Flight:
+    """Per-request observability context threaded from submit to finish:
+    the span identifying the request (the HTTP request span when the call
+    came through the middleware, else the ``queue.wait`` span's trace), the
+    open ``queue.wait`` span, and the flight-recorder record."""
+    __slots__ = ("link_span", "qspan", "record")
+
+    def __init__(self, link_span: Optional[Span], qspan: Optional[Span],
+                 record: RequestRecord):
+        self.link_span = link_span
+        self.qspan = qspan
+        self.record = record
+
+
 class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
-                 "inflight", "queue", "temperature", "fill", "submitted_at")
+                 "inflight", "queue", "temperature", "fill", "submitted_at",
+                 "record", "req_span", "phase_span")
 
     def __init__(self):
         self.future: Optional[asyncio.Future] = None
@@ -155,18 +173,24 @@ class _Slot:
         self.fill = 0         # host mirror of device cache_len (exact: set
                               # at admission, +k per participated tick) —
                               # picks the attention-window rung
+        self.record: Optional[RequestRecord] = None  # flight recorder entry
+        self.req_span: Optional[Span] = None   # request span (link target)
+        self.phase_span: Optional[Span] = None  # open prefill/decode span
 
 
 class _Fetch:
     """One dispatched device op whose tokens are being fetched to host in a
     worker thread. ``kind`` is "prefill" (payload: [(slot, gen, row)]) or
-    "tick" (payload: [(slot, gen)])."""
-    __slots__ = ("task", "kind", "payload")
+    "tick" (payload: [(slot, gen)]). ``span`` is the open engine-step span
+    (dispatch → publish), finished when the fetch lands."""
+    __slots__ = ("task", "kind", "payload", "span")
 
-    def __init__(self, task, kind: str, payload):
+    def __init__(self, task, kind: str, payload,
+                 span: Optional[Span] = None):
         self.task = task
         self.kind = kind
         self.payload = payload
+        self.span = span
 
 
 class GenerationEngine:
@@ -177,7 +201,7 @@ class GenerationEngine:
                  max_inflight_ticks: int = 2,
                  mesh=None,
                  window_ladder: bool = True,
-                 logger=None, metrics=None):
+                 logger=None, metrics=None, tracer=None, recorder=None):
         import jax
         import jax.numpy as jnp
 
@@ -227,6 +251,8 @@ class GenerationEngine:
             self._n_ladder.append(max_slots)
         self.logger = logger
         self.metrics = metrics
+        self.tracer = tracer   # None → span emission off, recorder still on
+        self.recorder: FlightRecorder = recorder or FlightRecorder()
 
         if mesh is not None:
             from gofr_tpu.ops.quant import quantized_specs
@@ -560,6 +586,22 @@ class GenerationEngine:
             raise ValueError("prompt + max_new_tokens exceeds cache length")
         return prompt, bucket
 
+    def _new_flight(self, prompt: List[int], budget: int) -> _Flight:
+        """Open the request's observability context at submit time: a
+        ``queue.wait`` child span under the caller's current span (the HTTP
+        request span when called from a handler — contextvars carry it into
+        this coroutine) and a flight-recorder record."""
+        parent = current_span() if self.tracer is not None else None
+        qspan = (self.tracer.start_span("queue.wait", parent=parent)
+                 if self.tracer is not None else None)
+        link_span = parent if parent is not None else qspan
+        record = RequestRecord(
+            model="generate", prompt_len=len(prompt), budget=budget,
+            trace_id=link_span.trace_id if link_span is not None else None,
+            span_id=link_span.span_id if link_span is not None else None)
+        self.recorder.start(record)
+        return _Flight(link_span, qspan, record)
+
     async def generate(self, prompt_ids, max_new_tokens: int,
                        eos_id: Optional[int] = None,
                        sampling: Optional[Sampling] = None) -> List[int]:
@@ -570,7 +612,8 @@ class GenerationEngine:
         future = asyncio.get_running_loop().create_future()
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, None,
-                                 time.monotonic()))
+                                 time.monotonic(),
+                                 self._new_flight(prompt, max_new_tokens)))
         self._wake.set()
         return await future
 
@@ -594,7 +637,8 @@ class GenerationEngine:
         future = asyncio.get_running_loop().create_future()
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, queue,
-                                 time.monotonic()))
+                                 time.monotonic(),
+                                 self._new_flight(prompt, max_new_tokens)))
         self._wake.set()
         return TokenStream(self, queue, future)
 
@@ -608,6 +652,7 @@ class GenerationEngine:
                 slot.gen += 1          # stale in-flight tokens are dropped
                 slot.inflight = 0
                 slot.queue = None
+                self._finish_slot(slot, "cancelled")
                 if slot.future is not None and not slot.future.done():
                     slot.future.cancel()
                 if slot_idx not in self._free:
@@ -627,12 +672,46 @@ class GenerationEngine:
     def stats(self) -> Dict[str, Any]:
         return {"active_slots": self.active_slots,
                 "free_slots": len(self._free),
+                "queue_depth": self._pending.qsize(),
                 "decode_steps": self._steps,
                 "prefill_batches": self._prefills,
                 "max_len": self.max_len,
                 "window_ladder": [w or self.max_len
                                   for w in self._window_ladder],
                 "mesh": dict(self.mesh.shape) if self.mesh else None}
+
+    def statusz(self, recent: int = 32) -> Dict[str, Any]:
+        """Live JSON snapshot for ``/debug/statusz``: admission queue depth,
+        per-slot state, KV-cache occupancy, and the flight recorder's
+        recent-request ring. Pure host bookkeeping — no device syncs."""
+        slots = []
+        for slot_idx, slot in enumerate(self._slots):
+            slots.append({
+                "slot": slot_idx,
+                "state": "active" if slot.active else "free",
+                "fill": slot.fill if slot.active else 0,
+                "remaining": slot.remaining if slot.active else 0,
+                "inflight_tokens": slot.inflight,
+                "streaming": slot.queue is not None,
+                "trace_id": (slot.record.trace_id
+                             if slot.record is not None else None),
+            })
+        tokens_in_cache = sum(s.fill for s in self._slots if s.active)
+        capacity = self.max_slots * self.max_len
+        return {
+            "queue_depth": self._pending.qsize(),
+            "ticks_inflight": self._ticks_inflight,
+            "slots": slots,
+            "kv_cache": {
+                "max_slots": self.max_slots,
+                "max_len": self.max_len,
+                "tokens_in_cache": tokens_in_cache,
+                "occupancy": round(tokens_in_cache / capacity, 6)
+                if capacity else 0.0,
+            },
+            "stats": self.stats(),
+            "requests": self.recorder.snapshot(limit=recent),
+        }
 
     def health_check(self) -> Dict[str, Any]:
         """Container-health contract (container/health.go analog)."""
@@ -668,6 +747,9 @@ class GenerationEngine:
                 # and an unawaited task would log "exception was never
                 # retrieved" (ADVICE r3)
                 for entry in self._publishq:
+                    if entry.span is not None:
+                        entry.span.set_status("ERROR")
+                        entry.span.finish()
                     try:
                         await entry.task
                     except asyncio.CancelledError:
@@ -722,6 +804,7 @@ class GenerationEngine:
                 slot.active = False
                 slot.gen += 1
                 slot.inflight = 0
+                self._finish_slot(slot, "error")
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_exception(exc)
                 if slot.queue is not None:
@@ -734,10 +817,10 @@ class GenerationEngine:
         q = self._publishq
         # 1. batched admission of everything pending (up to free slots);
         #    each prefill's first-token fetch starts concurrently
-        for first_dev, claimed in await self._admit_pending(loop):
+        for first_dev, claimed, step_span in await self._admit_pending(loop):
             q.append(_Fetch(loop.run_in_executor(None, np.asarray,
                                                  first_dev),
-                            "prefill", claimed))
+                            "prefill", claimed, span=step_span))
 
         # 2. dispatch the next decode tick(s) up to the pipeline depth;
         #    its token fetch starts immediately in its own worker thread
@@ -746,11 +829,11 @@ class GenerationEngine:
                 and self._ticks_inflight < self.max_inflight_ticks):
             tick = await self._dispatch_tick(loop)
             if tick is not None:
-                tokens_dev, snapshot = tick
+                tokens_dev, snapshot, step_span = tick
                 self._ticks_inflight += 1
                 q.append(_Fetch(loop.run_in_executor(None, np.asarray,
                                                      tokens_dev),
-                                "tick", snapshot))
+                                "tick", snapshot, span=step_span))
                 dispatched = True
 
         if not q:
@@ -778,6 +861,8 @@ class GenerationEngine:
             for slot_idx, gen in entry.payload:
                 self._push_tokens(slot_idx, gen,
                                   [int(t) for t in host[:, slot_idx]])
+        if entry.span is not None:   # step span covers dispatch → publish
+            entry.span.finish()
 
     async def _admit_pending(self, loop):
         """Drain the queue into slots; one batched prefill dispatch per
@@ -789,19 +874,24 @@ class GenerationEngine:
         if not requests:
             return []
         jnp = self._jnp
-        fetches: List[Tuple[Any, List[Tuple[int, int, int]]]] = []
+        fetches: List[Tuple[Any, List[Tuple[int, int, int]],
+                            Optional[Span]]] = []
         by_bucket: Dict[int, List[Tuple]] = {}
         for prompt, bucket, budget, eos_id, sampling, future, queue, \
-                submitted_at in requests:
+                submitted_at, flight in requests:
             if queue is not None and queue in self._cancelled_queues:
                 # stream consumer vanished before admission: drop it
                 self._cancelled_queues.discard(queue)
                 if not future.done():
                     future.cancel()
+                if flight.qspan is not None:
+                    flight.qspan.set_status("CANCELLED")
+                    flight.qspan.finish()
+                self.recorder.finish(flight.record, "cancelled")
                 continue
             by_bucket.setdefault(bucket, []).append(
                 (prompt, budget, eos_id, sampling, future, queue,
-                 submitted_at))
+                 submitted_at, flight))
         if self._pending.empty():
             # no queued request can match a leftover entry any more —
             # bound the set (cancel-after-completion would otherwise leak)
@@ -822,7 +912,7 @@ class GenerationEngine:
             seeds = np.zeros((nb,), np.uint32)
             claimed: List[Tuple[int, int, int]] = []          # (slot,gen,row)
             for row, (prompt, budget, eos_id, sampling, future, queue,
-                      submitted_at) in enumerate(group):
+                      submitted_at, flight) in enumerate(group):
                 slot_idx = self._free.pop()
                 slot = self._slots[slot_idx]
                 slot.future = future
@@ -836,6 +926,20 @@ class GenerationEngine:
                 slot.queue = queue
                 slot.temperature = sampling.temperature
                 slot.fill = len(prompt)    # device cache_len after insert
+                # queue.wait ends here; the prefill phase span opens, both
+                # in the request's own trace
+                if flight.qspan is not None:
+                    flight.qspan.set_attribute("slot", slot_idx)
+                    flight.qspan.finish()
+                flight.record.admitted()
+                slot.record = flight.record
+                slot.req_span = flight.link_span
+                slot.phase_span = (
+                    self.tracer.start_span("prefill", parent=flight.link_span)
+                    if self.tracer is not None else None)
+                if slot.phase_span is not None:
+                    slot.phase_span.set_attribute("slot", slot_idx)
+                    slot.phase_span.set_attribute("prompt_len", len(prompt))
                 padded[row, :len(prompt)] = prompt
                 lengths[row] = len(prompt)
                 slots[row] = slot_idx
@@ -868,14 +972,37 @@ class GenerationEngine:
         # Phase 2: dispatch per bucket (first-time compiles run off-loop;
         # warm dispatch is ~free)
         for nb, bucket, dispatch, claimed in staged:
+            step_span = self._step_span("tpu.engine.prefill", claimed,
+                                        bucket=bucket, padded_batch=nb)
             if (nb, bucket) in self._prefill_fns \
                     and (nb, bucket) in self._insert_fns:
                 first_dev = dispatch()
             else:
                 first_dev = await loop.run_in_executor(None, dispatch)
             self._prefills += 1
-            fetches.append((first_dev, claimed))
+            fetches.append((first_dev, claimed, step_span))
         return fetches
+
+    def _step_span(self, name: str, participants,
+                   **attributes) -> Optional[Span]:
+        """Open an engine-step span (root of its own trace — the engine loop
+        must not inherit whatever request context first started it) with
+        span links to every request it serves: the many-to-one edge of the
+        flight recorder. ``participants`` is a list of tuples whose first
+        element is a slot index. Finished by ``_publish`` when the step's
+        token fetch lands, so the span covers dispatch → device compute →
+        D2H fetch."""
+        if self.tracer is None:
+            return None
+        span = Span(self.tracer, name)
+        span.set_attribute("batch_size", len(participants))
+        for key, value in attributes.items():
+            span.set_attribute(key, value)
+        for entry in participants:
+            slot = self._slots[entry[0]]
+            if slot.req_span is not None:
+                span.add_link(slot.req_span)
+        return span
 
     async def _dispatch_tick(self, loop):
         """Choose K adaptively, dispatch one decode executable, return
@@ -913,6 +1040,8 @@ class GenerationEngine:
             snapshot.append((slot_idx, slot.gen))
             if slot.temperature > 0.0:
                 sampled = True
+            if slot.record is not None:
+                slot.record.rode_batch(len(eligible))
         window = self._pick_window(fills, k)
         # keep the mask device-resident: re-upload only when the active set
         # changed (H2D through a relay costs ~10ms; most ticks are stable)
@@ -937,18 +1066,27 @@ class GenerationEngine:
             self.last_token = tokens_dev[-1]
             return tokens_dev
 
+        step_span = self._step_span("tpu.engine.step", snapshot,
+                                    k=k, window=window or self.max_len,
+                                    sampled=sampled, step=self._steps)
         if (k, sampled, window) in self._decode_fns:
             tokens_dev = dispatch()
         else:
             tokens_dev = await loop.run_in_executor(None, dispatch)
         self._steps += 1
         if self.metrics is not None:
+            exemplar = next(
+                ({"trace_id": slot.record.trace_id}
+                 for _, slot in eligible
+                 if slot.record is not None and slot.record.trace_id),
+                None)
             self.metrics.record_histogram(
-                "app_tpu_batch_size", float(len(snapshot)), model="generate")
+                "app_tpu_batch_size", float(len(snapshot)),
+                exemplar=exemplar, model="generate")
             self.metrics.set_gauge(
                 "app_tpu_attention_window",
                 float(window or self.max_len), model="generate")
-        return tokens_dev, snapshot
+        return tokens_dev, snapshot, step_span
 
     def _push_tokens(self, slot_idx: int, gen: int,
                      tokens: List[int]) -> None:
@@ -960,26 +1098,60 @@ class GenerationEngine:
         slot.inflight -= len(tokens)
         if not slot.active:
             return
-        if not slot.tokens and self.metrics is not None:
+        if not slot.tokens:
             # first published token for this request: submit → now is the
             # operator-facing TTFT — admission wait + prefill dispatch +
             # fetch (the first token is sampled in the prefill executable,
             # so no decode tick is included)
-            self.metrics.record_histogram(
-                "app_tpu_ttft", time.monotonic() - slot.submitted_at,
-                model="generate")
+            if slot.record is not None:
+                slot.record.first_token()
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_tpu_ttft", time.monotonic() - slot.submitted_at,
+                    exemplar=({"trace_id": slot.record.trace_id}
+                              if slot.record is not None
+                              and slot.record.trace_id else None),
+                    model="generate")
+            # prefill phase ends at the first token; decode begins
+            if slot.phase_span is not None:
+                slot.phase_span.finish()
+                slot.phase_span = None
+            if self.tracer is not None:
+                slot.phase_span = self.tracer.start_span(
+                    "decode", parent=slot.req_span)
+                slot.phase_span.set_attribute("slot", slot_idx)
         for token in tokens:
             slot.tokens.append(token)
             slot.remaining -= 1
+            if slot.record is not None:
+                slot.record.tokens += 1
             if slot.queue is not None:
                 slot.queue.put_nowait(token)
             if (slot.remaining <= 0
                     or (slot.eos_id is not None and token == slot.eos_id)):
                 slot.active = False    # rest of the chunk is discarded
                 self._free.append(slot_idx)
+                self._finish_slot(slot, "done")
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_result(list(slot.tokens))
                 if slot.queue is not None:
                     slot.queue.put_nowait(_DONE)
                     slot.queue = None
                 break
+
+    def _finish_slot(self, slot: _Slot, status: str) -> None:
+        """Close a slot's observability state: finish the open phase span
+        (tagging non-success statuses) and retire the flight record."""
+        if slot.phase_span is not None:
+            if status != "done":
+                slot.phase_span.set_status(
+                    "ERROR" if status == "error" else "CANCELLED")
+                slot.phase_span.set_attribute("outcome", status)
+            slot.phase_span.finish()
+            slot.phase_span = None
+        if slot.record is not None:
+            if slot.record.tokens:
+                slot.record.first_token()   # idempotent backstop
+            self.recorder.finish(slot.record, status)
+            slot.record = None
+        slot.req_span = None

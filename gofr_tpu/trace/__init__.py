@@ -1,4 +1,5 @@
 from gofr_tpu.trace.tracer import (
+    ListExporter,
     Span,
     Tracer,
     current_span,
@@ -8,6 +9,7 @@ from gofr_tpu.trace.tracer import (
 )
 
 __all__ = [
+    "ListExporter",
     "Span",
     "Tracer",
     "current_span",

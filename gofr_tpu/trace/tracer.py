@@ -32,6 +32,9 @@ _TRACEPARENT_RE = re.compile(
     r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
 )
 
+# sentinel telling the export worker to flush its batch and exit
+_STOP = object()
+
 
 def _rand_hex(nbits: int) -> str:
     return f"{random.getrandbits(nbits):0{nbits // 4}x}"
@@ -45,7 +48,7 @@ class Span:
     """
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
-                 "attributes", "_tracer", "_token", "status")
+                 "attributes", "links", "_tracer", "_token", "status")
 
     def __init__(self, tracer: Optional["Tracer"], name: str,
                  trace_id: Optional[str] = None, parent_id: Optional[str] = None):
@@ -56,12 +59,19 @@ class Span:
         self.start = time.time()
         self.end: Optional[float] = None
         self.attributes: Dict[str, str] = {}
+        self.links: List[Dict[str, str]] = []
         self.status: str = "OK"
         self._tracer = tracer
         self._token: Optional[contextvars.Token] = None
 
     def set_attribute(self, key: str, value) -> None:
         self.attributes[str(key)] = str(value)
+
+    def add_link(self, other: "Span") -> None:
+        """Link another span (many-to-one causality, e.g. one batched engine
+        step serving several requests — OTel span-links analog)."""
+        self.links.append({"trace_id": other.trace_id,
+                           "span_id": other.span_id})
 
     def set_status(self, status: str) -> None:
         self.status = status
@@ -119,6 +129,20 @@ class _Exporter:
         pass
 
 
+class ListExporter(_Exporter):
+    """Collects exported spans in memory — test double / flight-recorder
+    introspection (``Tracer(exporter=ListExporter())``)."""
+
+    def __init__(self):
+        self.spans: List[Span] = []
+
+    def export(self, spans: List[Span]) -> None:
+        self.spans.extend(spans)
+
+    def find(self, name: str) -> List[Span]:
+        return [s for s in self.spans if s.name == name]
+
+
 class _ConsoleExporter(_Exporter):
     def export(self, spans: List[Span]) -> None:
         for span in spans:
@@ -145,7 +169,13 @@ class _ZipkinExporter(_Exporter):
                 "timestamp": int(span.start * 1e6),
                 "duration": int(((span.end or span.start) - span.start) * 1e6),
                 "localEndpoint": {"serviceName": self.service_name},
-                "tags": dict(span.attributes, status=span.status),
+                # Zipkin v2 has no first-class span links; encode them as a
+                # tag so the linked trace ids survive into the UI
+                "tags": dict(
+                    span.attributes, status=span.status,
+                    **({"links": ",".join(
+                        f"{l['trace_id']}:{l['span_id']}"
+                        for l in span.links)} if span.links else {})),
             }
             for span in spans
         ]).encode()
@@ -178,11 +208,19 @@ class Tracer:
             )
             self._worker.start()
 
-    def start_span(self, name: str, remote_parent: Optional[Dict[str, str]] = None) -> Span:
-        parent = current_span()
+    def start_span(self, name: str,
+                   remote_parent: Optional[Dict[str, str]] = None,
+                   parent: Optional[Span] = None) -> Span:
+        """Start a span. Parent resolution: ``remote_parent`` (a parsed
+        ``traceparent``) wins, then an explicit ``parent`` span, then the
+        context-local current span. An explicit ``parent`` is how background
+        tasks (batcher flushes, engine ticks) attach child spans to a
+        request whose contextvar scope they never run under."""
         if remote_parent is not None:
             return Span(self, name, trace_id=remote_parent["trace_id"],
                         parent_id=remote_parent["span_id"])
+        if parent is None:
+            parent = current_span()
         if parent is not None:
             return Span(self, name, trace_id=parent.trace_id,
                         parent_id=parent.span_id)
@@ -200,9 +238,11 @@ class Tracer:
         batch: List[Span] = []
         while True:
             try:
-                span = self._queue.get(timeout=1.0)
+                item = self._queue.get(timeout=1.0)
             except queue.Empty:
-                span = None
+                item = None
+            stopping = item is _STOP
+            span = None if stopping else item
             if span is not None:
                 batch.append(span)
             if batch and (span is None or len(batch) >= 128):
@@ -211,10 +251,37 @@ class Tracer:
                 except Exception:
                     pass
                 batch = []
+            if stopping:
+                return
 
-    def shutdown(self) -> None:
-        if self._exporter is not None:
-            self._exporter.shutdown()
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Drain queued spans and export the final batch before closing the
+        exporter — spans finished just before shutdown must not be lost."""
+        if self._exporter is None:
+            return
+        if self._worker is not None and self._worker.is_alive():
+            try:
+                self._queue.put_nowait(_STOP)
+            except queue.Full:
+                pass  # drained inline below
+            self._worker.join(timeout=timeout)
+            self._worker = None
+        # anything still queued (full queue above, dead worker, or spans
+        # finished while the worker was stopping) exports inline
+        batch: List[Span] = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                batch.append(item)
+        if batch:
+            try:
+                self._exporter.export(batch)
+            except Exception:
+                pass
+        self._exporter.shutdown()
 
 
 def new_tracer(config, logger=None) -> Tracer:

@@ -53,3 +53,19 @@ def test_payload_serialization():
     logger.info("query", payload=QueryLog())
     entry = json.loads(out.getvalue())
     assert entry["payload"]["query"] == "SELECT 1"
+
+
+def test_trace_and_span_ids_injected():
+    """Log lines inside a span carry both ids, so logs join traces and
+    the flight recorder without parsing traceparent."""
+    from gofr_tpu.trace import Tracer
+    logger, out, _ = make_logger()
+    tracer = Tracer()
+    with tracer.start_span("work") as span:
+        logger.info("inside")
+    logger.info("outside")
+    inside, outside = [json.loads(line)
+                       for line in out.getvalue().splitlines()]
+    assert inside["trace_id"] == span.trace_id
+    assert inside["span_id"] == span.span_id
+    assert "trace_id" not in outside and "span_id" not in outside

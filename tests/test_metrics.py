@@ -46,3 +46,93 @@ def test_updown_and_exposition_format():
     text = render_prometheus(manager)
     assert "# TYPE inflight gauge" in text
     assert "inflight 2" in text
+
+
+# -- exposition conformance (ISSUE 1 satellite) -------------------------------
+
+def test_histogram_cumulation_closes_at_count_per_series():
+    """Prometheus text rules per labelled series: bucket counts are
+    cumulative in `le` order and the +Inf bucket equals _count."""
+    manager = Manager()
+    manager.new_histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+    for value in (0.005, 0.05, 0.05, 0.5, 5.0):
+        manager.record_histogram("lat", value, path="/a")
+    manager.record_histogram("lat", 0.2, path="/b")
+    text = render_prometheus(manager)
+    for path, expect_count in (("/a", 5), ("/b", 1)):
+        buckets = []
+        for line in text.splitlines():
+            if line.startswith("lat_bucket") and f'path="{path}"' in line:
+                buckets.append(float(line.rsplit(" ", 1)[1]))
+        assert buckets == sorted(buckets), f"non-cumulative for {path}"
+        assert buckets[-1] == expect_count       # +Inf closes at _count
+        assert f'lat_count{{path="{path}"}} {expect_count}' in text
+
+
+def test_label_value_escaping():
+    manager = Manager()
+    manager.new_counter("hits")
+    manager.increment_counter("hits", path='a"b\\c\nd')
+    text = render_prometheus(manager)
+    assert 'hits{path="a\\"b\\\\c\\nd"} 1' in text
+
+
+def test_exemplar_round_trip():
+    """record_histogram(exemplar=...) → OpenMetrics `# {labels} value ts`
+    suffix on the exact bucket the observation fell in."""
+    manager = Manager()
+    manager.new_histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+    manager.record_histogram("lat", 0.05, exemplar={"trace_id": "ab" * 16})
+    manager.record_histogram("lat", 9.0, exemplar={"trace_id": "cd" * 16})
+    text = render_prometheus(manager)
+    line_mid = next(line for line in text.splitlines()
+                    if line.startswith('lat_bucket{le="0.1"}'))
+    assert f' # {{trace_id="{"ab" * 16}"}} 0.05 ' in line_mid
+    line_inf = next(line for line in text.splitlines()
+                    if line.startswith('lat_bucket{le="+Inf"}'))
+    assert f' # {{trace_id="{"cd" * 16}"}} 9 ' in line_inf
+    # buckets without an exemplar carry no annotation
+    line_low = next(line for line in text.splitlines()
+                    if line.startswith('lat_bucket{le="0.01"}'))
+    assert "#" not in line_low
+
+
+def test_exemplar_last_observation_wins():
+    manager = Manager()
+    manager.new_histogram("lat", "latency", buckets=(1.0,))
+    manager.record_histogram("lat", 0.2, exemplar={"trace_id": "old"})
+    manager.record_histogram("lat", 0.3, exemplar={"trace_id": "new"})
+    text = render_prometheus(manager)
+    assert 'trace_id="new"' in text and 'trace_id="old"' not in text
+
+
+def test_exemplar_without_histogram_kind_is_noop():
+    manager = Manager()
+    manager.new_counter("c")
+    manager.record_histogram("c", 1.0, exemplar={"trace_id": "x"})
+    assert "trace_id" not in render_prometheus(manager)
+
+
+def test_current_rss_is_live_not_peak():
+    """memory_rss_bytes must come from /proc/self/statm (current RSS) when
+    procfs exists, not ru_maxrss (the high-water mark)."""
+    import os
+
+    from gofr_tpu.metrics.manager import (current_rss_bytes,
+                                          system_metrics_refresh)
+    rss = current_rss_bytes()
+    if os.path.exists("/proc/self/statm"):
+        assert rss is not None and rss > 1024 * 1024
+    manager = Manager()
+    manager.new_gauge("app_info")
+    manager.new_gauge("threads_total")
+    manager.new_gauge("memory_rss_bytes")
+    manager.new_gauge("gc_objects")
+    manager.new_gauge("uptime_seconds")
+    system_metrics_refresh(manager, "svc", "v1")
+    reported = manager.value("memory_rss_bytes")
+    assert reported is not None and reported > 0
+    if rss is not None:
+        # same order of magnitude as the live reading, allowing for
+        # allocator noise between the two samples
+        assert 0.5 < reported / rss < 2.0

@@ -59,3 +59,30 @@ def test_profiler_endpoints(tmp_path):
             again = await http_request(port, "POST", "/debug/profiler/stop")
             assert again.json()["data"]["status"] == "not profiling"
     run(main())
+
+
+def test_profiler_state_is_per_app(tmp_path):
+    """Two apps in one process: one app's profiling session must not be
+    visible through (or clobbered by) the other's endpoints."""
+    async def main():
+        app_a, app_b = make_app(), make_app()
+        app_a.enable_profiler()
+        app_b.enable_profiler()
+        trace_dir = str(tmp_path / "trace-a")
+        async with serving(app_a) as port_a:
+            async with serving(app_b) as port_b:
+                started = await http_request(
+                    port_a, "POST", "/debug/profiler/start",
+                    body=json.dumps({"dir": trace_dir}).encode(),
+                    headers={"Content-Type": "application/json"})
+                assert started.json()["data"]["status"] == "started"
+                # B has its own state: it is not profiling, and its stop
+                # must not end A's session
+                other = await http_request(port_b, "POST",
+                                           "/debug/profiler/stop")
+                assert other.json()["data"]["status"] == "not profiling"
+                stopped = await http_request(port_a, "POST",
+                                             "/debug/profiler/stop")
+                assert stopped.json()["data"]["status"] == "stopped"
+                assert stopped.json()["data"]["dir"] == trace_dir
+    run(main())

@@ -40,3 +40,44 @@ def test_remote_parent_adopted():
     assert span.trace_id == "ab" * 16
     assert span.parent_id == "cd" * 8
     span.finish()
+
+
+def test_explicit_parent_and_links():
+    """Background tasks attach children via parent=; batched step spans
+    link many request spans (OTel span-links analog)."""
+    tracer = Tracer()
+    with tracer.start_span("request") as req:
+        pass
+    child = tracer.start_span("queue.wait", parent=req)
+    assert child.trace_id == req.trace_id
+    assert child.parent_id == req.span_id
+    child.finish()
+
+    step = tracer.start_span("tpu.engine.step")   # no current span → root
+    assert step.trace_id != req.trace_id
+    step.add_link(req)
+    step.add_link(child)
+    assert step.links == [
+        {"trace_id": req.trace_id, "span_id": req.span_id},
+        {"trace_id": child.trace_id, "span_id": child.span_id},
+    ]
+    step.finish()
+
+
+def test_shutdown_drains_pending_spans():
+    """Spans finished immediately before shutdown must still export —
+    shutdown stops the worker, then drains whatever is left in the queue."""
+    from gofr_tpu.trace import ListExporter
+    exporter = ListExporter()
+    tracer = Tracer(exporter=exporter)
+    for i in range(300):   # > the worker's 128-span batch size
+        tracer.start_span(f"s{i}").finish()
+    tracer.shutdown()
+    assert len(exporter.spans) == 300
+    assert {s.name for s in exporter.spans} == {f"s{i}" for i in range(300)}
+    tracer.shutdown()      # idempotent
+    assert len(exporter.spans) == 300
+
+
+def test_shutdown_without_exporter_is_noop():
+    Tracer().shutdown()
