@@ -67,9 +67,14 @@ def build_app():
         logger=app.logger, metrics=app.container.metrics,
         # flight recorder: queue.wait/prefill/decode child spans per
         # request, engine-step spans with links, /debug/statusz timelines
-        tracer=app.container.tracer)
+        tracer=app.container.tracer,
+        # SLO accounting: X-Request-Deadline-Ms classification (ok/
+        # violated/expired), windowed TTFT quantiles, goodput vs raw
+        # tokens/s — feeds /debug/varz and the degradation watchdog
+        slo=app.container.slo)
     app.container.tpu = engine  # surfaces engine health under /.well-known
     app.enable_statusz()        # live queue/slot/KV-cache/timeline snapshot
+    app.enable_varz()           # windowed SLO/goodput/saturation numbers
 
     @app.on_startup
     async def warm_engine():

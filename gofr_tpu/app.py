@@ -221,6 +221,11 @@ class App:
         from gofr_tpu.statusz import enable_statusz
         enable_statusz(self, prefix)
 
+    # -- SLO/saturation varz (no reference analog; varz.py) -----------------
+    def enable_varz(self, prefix: str = "/debug/varz") -> None:
+        from gofr_tpu.varz import enable_varz
+        enable_varz(self, prefix)
+
     # -- external DB injection (externalDB.go:5-39) -------------------------
     def add_mongo(self, client=None) -> None:
         if client is None:
@@ -304,6 +309,15 @@ class App:
             system_metrics_refresh(self.container.metrics,
                                    self.container.app_name,
                                    self.container.app_version)
+            # windowed SLO rates + device saturation refresh per scrape,
+            # same idiom as the runtime gauges above
+            self.container.slo.export_gauges()
+            if self.container.tpu is not None \
+                    and hasattr(self.container.tpu, "saturation"):
+                try:
+                    self.container.tpu.saturation()
+                except Exception as exc:
+                    self.logger.error("saturation refresh failed: %r", exc)
             body = render_prometheus(self.container.metrics).encode()
             return 200, {"Content-Type": "text/plain; version=0.0.4"}, body
         return 404, {}, b"not found"
@@ -323,7 +337,18 @@ class App:
             if message is None:
                 return
             ctx = Context(message, self.container)
-            with self.container.tracer.start_span(f"subscribe:{topic}"):
+            # continue the publisher's trace when the broker carried a
+            # traceparent header (kafka envelope / inmem metadata)
+            from gofr_tpu.trace import extract_traceparent
+            remote = None
+            try:
+                remote = extract_traceparent(
+                    message.header("traceparent") or "")
+            except Exception:
+                remote = None
+            with self.container.tracer.start_span(
+                    "pubsub.consume", remote_parent=remote) as span:
+                span.set_attribute("topic", topic)
                 try:
                     result = handler(ctx)
                     if asyncio.iscoroutine(result):
@@ -353,7 +378,17 @@ class App:
                 self.container.tpu,
                 max_batch=self.config.get_int("TPU_MAX_BATCH", 32),
                 max_delay_ms=self.config.get_float("TPU_BATCH_DELAY_MS", 2.0),
-                logger=self.logger, tracer=self.container.tracer)
+                logger=self.logger, tracer=self.container.tracer,
+                slo=self.container.slo)
+
+        # degradation watchdog over the SLO rolling windows (slo.py);
+        # SLO_WATCHDOG_ENABLED=false opts out entirely
+        from gofr_tpu.slo import new_watchdog
+        self.container.watchdog = new_watchdog(
+            self.config, self.container.slo, metrics=self.container.metrics,
+            logger=self.logger)
+        if self.container.watchdog is not None:
+            self.container.watchdog.start()
 
         self._metrics_server = HTTPServer(
             self._metrics_dispatch, self.metrics_port, logger=self.logger)
@@ -383,6 +418,8 @@ class App:
 
     async def stop(self) -> None:
         self.crontab.stop()
+        if self.container.watchdog is not None:
+            await self.container.watchdog.stop()
         for task in self._tasks:
             task.cancel()
         self._tasks.clear()

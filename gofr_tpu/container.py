@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 from gofr_tpu.config import Config, MapConfig
 from gofr_tpu.logging import Level, Logger, new_logger, new_silent_logger
 from gofr_tpu.metrics import Manager, new_manager
+from gofr_tpu.slo import SLOTracker
 from gofr_tpu.trace import Tracer, new_tracer
 from gofr_tpu.version import FRAMEWORK_VERSION
 
@@ -35,6 +36,10 @@ class Container:
         self.metrics: Manager = new_manager(self.logger)
         self.tracer: Tracer = Tracer()
         self.services: Dict[str, Any] = {}
+        # SLO accounting (windowed goodput/TTFT) + degradation watchdog;
+        # the watchdog is created by App.start (it needs the event loop)
+        self.slo = SLOTracker(self.metrics)
+        self.watchdog = None
 
         # datasources (all optional; wired by create())
         self.sql = None
@@ -80,7 +85,9 @@ class Container:
         backend = (config.get("PUBSUB_BACKEND") or "").upper()
         if backend:
             from gofr_tpu.datasource.pubsub import new_pubsub
-            container.pubsub = new_pubsub(backend, config, log, container.metrics)
+            container.pubsub = new_pubsub(backend, config, log,
+                                          container.metrics,
+                                          tracer=container.tracer)
 
         # file datasource (container.go:145)
         from gofr_tpu.datasource.file import LocalFileSystem
@@ -136,6 +143,36 @@ class Container:
             "time to first generated token (s): admission wait + prefill "
             "(the first token is sampled inside the prefill executable)",
             (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0))
+        # SLO & saturation catalog (ISSUE 2): goodput vs raw throughput,
+        # deadline outcome counts, device utilization, health transitions
+        metrics.new_counter(
+            "app_tpu_slo_total",
+            "terminal requests by deadline outcome (ok|violated|expired)")
+        metrics.new_gauge("app_tpu_tokens_per_s",
+                          "raw generated tokens/s over the rolling window")
+        metrics.new_gauge(
+            "app_tpu_goodput_tokens_per_s",
+            "tokens/s of requests that completed within deadline")
+        metrics.new_gauge(
+            "app_tpu_slo_attainment",
+            "fraction of windowed terminal requests that met their deadline")
+        metrics.new_gauge(
+            "app_tpu_duty_cycle",
+            "fraction of the rolling window the device spent executing")
+        metrics.new_gauge(
+            "app_tpu_mfu",
+            "model flops utilization vs TPU_PEAK_FLOPS over the window")
+        metrics.new_gauge("app_tpu_hbm_occupancy",
+                          "HBM bytes_in_use / bytes_limit per device")
+        metrics.new_counter(
+            "app_health_transitions_total",
+            "watchdog READY<->DEGRADED flips, labeled by target state")
+        metrics.new_updown_counter("app_http_inflight",
+                                   "inbound HTTP requests currently in flight")
+        metrics.new_histogram("app_cron_duration", "cron job run time (s)",
+                              (0.001, 0.01, 0.1, 1, 10, 60, 300))
+        metrics.new_counter("app_cron_runs_total",
+                            "cron job runs by job name and result")
 
     # -- outbound services (container.go:150-152) ---------------------------
     def add_http_service(self, name: str, service: Any) -> None:
@@ -172,6 +209,13 @@ class Container:
             details.setdefault("services", {})[name] = health
             statuses.append(health.get("status", "DOWN"))
         details["status"] = "DEGRADED" if "DOWN" in statuses else "UP"
+        # SLO watchdog override: a replica whose rolling-window attainment
+        # or p99 TTFT crossed its thresholds reports DEGRADED so load
+        # balancers drain it even while every datasource is UP
+        if self.watchdog is not None:
+            details["watchdog"] = self.watchdog.statusz()
+            if self.watchdog.state == "DEGRADED":
+                details["status"] = "DEGRADED"
         return details
 
     async def close(self) -> None:
@@ -203,7 +247,8 @@ def new_mock_container(config: Optional[Dict[str, str]] = None) -> Container:
     from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
     from gofr_tpu.datasource.redisx import InMemoryRedis
     from gofr_tpu.datasource.sql import new_sql
-    container.pubsub = InMemoryBroker(container.logger, container.metrics)
+    container.pubsub = InMemoryBroker(container.logger, container.metrics,
+                                      tracer=container.tracer)
     # unsandboxed: tests hand the fixture absolute tmp paths; production
     # Container.create keeps the sandboxed default
     container.file = LocalFileSystem(container.logger, sandbox=False)

@@ -140,13 +140,26 @@ class Crontab:
 
     async def _run_job(self, job: CronJob) -> None:
         """Run one firing inside a span with a no-op request Context
-        (cron.go:244-254), with panic isolation."""
+        (cron.go:244-254), with panic isolation. Each firing is observed:
+        ``app_cron_duration`` (per job) plus an ``app_cron_runs_total``
+        success/failure count, so a silently-failing nightly job shows up
+        in dashboards and not just in a log line."""
         ctx = Context(_NoopRequest(), self.container)
+        metrics = self.container.metrics
+        started = time.perf_counter()
         with self.container.tracer.start_span(f"cron:{job.name}"):
             try:
                 result = job.func(ctx)
                 if hasattr(result, "__await__"):
                     await result
+                metrics.increment_counter("app_cron_runs_total",
+                                          job=job.name, result="success")
             except Exception as exc:
                 self.container.logger.error(
                     "cron job %s panicked: %r", job.name, exc)
+                metrics.increment_counter("app_cron_runs_total",
+                                          job=job.name, result="failure")
+            finally:
+                metrics.record_histogram(
+                    "app_cron_duration", time.perf_counter() - started,
+                    job=job.name)

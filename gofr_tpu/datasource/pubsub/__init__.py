@@ -18,18 +18,18 @@ from gofr_tpu.datasource.pubsub.base import Message, PubSub
 __all__ = ["Message", "PubSub", "new_pubsub"]
 
 
-def new_pubsub(backend: str, config, logger, metrics) -> PubSub:
+def new_pubsub(backend: str, config, logger, metrics, tracer=None) -> PubSub:
     """Backend switch from config (reference: container/container.go:92-143)."""
     backend = backend.upper()
     if backend in ("INMEM", "MEMORY"):
         from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
-        return InMemoryBroker(logger, metrics)
+        return InMemoryBroker(logger, metrics, tracer=tracer)
     if backend == "MQTT":
         from gofr_tpu.datasource.pubsub.mqtt import MQTTClient
         return MQTTClient(config, logger, metrics)
     if backend == "KAFKA":
         from gofr_tpu.datasource.pubsub.kafka import KafkaClient
-        return KafkaClient(config, logger, metrics)
+        return KafkaClient(config, logger, metrics, tracer=tracer)
     if backend == "GOOGLE":
         from gofr_tpu.datasource.pubsub.google import GoogleClient
         return GoogleClient(config, logger, metrics)

@@ -8,7 +8,40 @@ the framework Request contract: ``param("topic")``, scalar/JSON ``bind``).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+# -- trace-context envelope ---------------------------------------------------
+# Kafka's message-set v1 wire format (datasource/pubsub/kafka.py) has no
+# native record headers, so cross-service trace propagation uses a tiny
+# opt-in byte envelope around the payload: MAGIC + uint16 traceparent
+# length + traceparent + original payload. Applied only when a span is
+# active at publish time; consumers that don't know the envelope still see
+# a payload whose first bytes are the magic (never valid JSON/UTF-8 text),
+# and gofr-tpu consumers unwrap it transparently.
+_TRACE_MAGIC = b"\x00GTR1"
+
+
+def encode_trace_envelope(traceparent: str, payload: bytes) -> bytes:
+    """Wrap ``payload`` with a ``traceparent`` header (W3C string)."""
+    header = traceparent.encode("ascii", "replace")
+    return _TRACE_MAGIC + struct.pack(">H", len(header)) + header + payload
+
+
+def decode_trace_envelope(raw: bytes) -> Tuple[Optional[str], bytes]:
+    """Unwrap a trace envelope → (traceparent, payload). Non-enveloped
+    input returns ``(None, raw)`` unchanged — safe on any byte stream."""
+    if not raw.startswith(_TRACE_MAGIC):
+        return None, raw
+    offset = len(_TRACE_MAGIC)
+    if len(raw) < offset + 2:
+        return None, raw
+    (length,) = struct.unpack_from(">H", raw, offset)
+    offset += 2
+    if len(raw) < offset + length:
+        return None, raw
+    header = raw[offset:offset + length].decode("ascii", "replace")
+    return header, raw[offset + length:]
 
 
 class Message:

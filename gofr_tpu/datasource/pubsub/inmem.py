@@ -4,6 +4,12 @@ The test double and single-process backend — the role miniredis/mocked Kafka
 readers play in the reference's test strategy (SURVEY.md §4). Topics are
 asyncio queues; consumer groups see each message once (queue semantics, like
 a Kafka consumer group with one partition).
+
+Trace propagation (ISSUE 2): ``publish`` runs inside a ``pubsub.publish``
+span and injects its W3C ``traceparent`` as a message header, which
+``subscribe`` surfaces via ``Message.header("traceparent")`` — the
+subscriber loop continues the publisher's trace exactly as HTTP ingress
+does for inbound requests.
 """
 
 from __future__ import annotations
@@ -17,9 +23,11 @@ from gofr_tpu.datasource.pubsub.base import Message, PubSub
 
 
 class InMemoryBroker(PubSub):
-    def __init__(self, logger=None, metrics=None, maxsize: int = 65536):
+    def __init__(self, logger=None, metrics=None, maxsize: int = 65536,
+                 tracer=None):
         self.logger = logger
         self.metrics = metrics
+        self.tracer = tracer
         self.maxsize = maxsize
         self._queues: Dict[str, asyncio.Queue] = {}
         self._published = 0
@@ -37,15 +45,28 @@ class InMemoryBroker(PubSub):
         if self.metrics is not None:
             self.metrics.increment_counter("app_pubsub_publish_total_count",
                                            topic=topic)
+        headers: Dict[str, str] = {}
+        span = None
+        if self.tracer is not None:
+            from gofr_tpu.trace import format_traceparent
+            span = self.tracer.start_span("pubsub.publish")
+            span.set_attribute("topic", topic)
+            span.set_attribute("backend", "INMEM")
+            headers["traceparent"] = format_traceparent(span)
         try:
-            self._queue(topic).put_nowait((payload, key))
+            self._queue(topic).put_nowait((payload, key, headers))
             self._published += 1
             if self.metrics is not None:
                 self.metrics.increment_counter(
                     "app_pubsub_publish_success_count", topic=topic)
         except asyncio.QueueFull:
+            if span is not None:
+                span.set_status("ERROR")
             if self.logger is not None:
                 self.logger.error("inmem broker: topic %s full, dropping", topic)
+        finally:
+            if span is not None:
+                span.finish()
 
     async def subscribe(self, topic: str) -> Optional[Message]:
         if self.metrics is not None:
@@ -53,12 +74,13 @@ class InMemoryBroker(PubSub):
                                            topic=topic)
         if self._closed:
             return None
-        payload, key = await self._queue(topic).get()
+        payload, key, headers = await self._queue(topic).get()
         self._delivered += 1
         if self.metrics is not None:
             self.metrics.increment_counter("app_pubsub_subscribe_success_count",
                                            topic=topic)
-        return Message(topic, payload, key, committer=lambda: None)
+        return Message(topic, payload, key, metadata=dict(headers),
+                       committer=lambda: None)
 
     def create_topic(self, topic: str) -> None:
         self._queue(topic)

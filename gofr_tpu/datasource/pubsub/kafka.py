@@ -37,7 +37,12 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
-from gofr_tpu.datasource.pubsub.base import Message, PubSub
+from gofr_tpu.datasource.pubsub.base import (
+    Message,
+    PubSub,
+    decode_trace_envelope,
+    encode_trace_envelope,
+)
 
 API_PRODUCE, API_FETCH, API_LIST_OFFSETS, API_METADATA = 0, 1, 2, 3
 API_OFFSET_COMMIT, API_OFFSET_FETCH = 8, 9
@@ -401,10 +406,17 @@ class _PartitionFetcher(threading.Thread):
                     continue
                 for offset, key, value in batch:
                     self.offset = offset + 1
+                    # unwrap the opt-in trace envelope (base.py): the
+                    # publisher's traceparent surfaces as a message
+                    # header, exactly like inmem's native headers
+                    traceparent, value = decode_trace_envelope(value)
+                    metadata: Dict[str, Any] = {"partition": self.partition,
+                                                "offset": offset}
+                    if traceparent is not None:
+                        metadata["traceparent"] = traceparent
                     message = Message(
                         self.topic, value, key,
-                        metadata={"partition": self.partition,
-                                  "offset": offset},
+                        metadata=metadata,
                         committer=self.make_committer(self.partition,
                                                       offset + 1))
                     while not self._stopping():
@@ -429,9 +441,10 @@ class _PartitionFetcher(threading.Thread):
 
 
 class KafkaClient(PubSub):
-    def __init__(self, config, logger, metrics):
+    def __init__(self, config, logger, metrics, tracer=None):
         self.logger = logger
         self.metrics = metrics
+        self.tracer = tracer
         broker = config.get_or_default("PUBSUB_BROKER",
                                        config.get_or_default("KAFKA_BROKER",
                                                              "localhost:9092"))
@@ -526,6 +539,31 @@ class KafkaClient(PubSub):
     def publish(self, topic: str, payload: bytes, key: bytes = b"") -> None:
         self.metrics.increment_counter("app_pubsub_publish_total_count",
                                        topic=topic)
+        # cross-service trace propagation: message-set v1 has no record
+        # headers, so when a trace is in flight the traceparent rides in
+        # the opt-in byte envelope (base.py). Publishes outside a span
+        # keep the raw wire payload byte-for-byte unchanged.
+        span = None
+        if self.tracer is not None:
+            from gofr_tpu.trace import current_span, format_traceparent
+            if current_span() is not None:
+                span = self.tracer.start_span("pubsub.publish")
+                span.set_attribute("topic", topic)
+                span.set_attribute("backend", "KAFKA")
+                payload = encode_trace_envelope(format_traceparent(span),
+                                                payload)
+        try:
+            self._publish_raw(topic, payload, key)
+        except Exception:
+            if span is not None:
+                span.set_status("ERROR")
+            raise
+        finally:
+            if span is not None:
+                span.finish()
+
+    def _publish_raw(self, topic: str, payload: bytes,
+                     key: bytes = b"") -> None:
         partitions = self._refresh_metadata(topic) or [0]
         partition = (zlib.crc32(key) % len(partitions)) if key \
             else int(time.time() * 1e6) % len(partitions)

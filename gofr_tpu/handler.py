@@ -22,6 +22,7 @@ from gofr_tpu.context import Context
 from gofr_tpu.http.errors import HTTPError, InvalidRoute, PanicRecovery, RequestTimeout
 from gofr_tpu.http.request import Request
 from gofr_tpu.http.responder import Responder
+from gofr_tpu.slo import parse_deadline_header, set_request_deadline
 
 Handler = Callable[[Context], Any]
 
@@ -34,6 +35,11 @@ def wrap_handler(func: Handler, container, timeout: Optional[float] = None):
 
     async def wire_handler(request: Request):
         ctx = Context(request, container, _responder)
+        # deadline budget (X-Request-Deadline-Ms) -> absolute monotonic
+        # instant in a contextvar; to_thread propagates contextvars, so the
+        # TPU batcher/engine see it from both async and sync handlers
+        set_request_deadline(
+            parse_deadline_header(request.header("X-Request-Deadline-Ms")))
         try:
             if is_async:
                 coro: Any = func(ctx)

@@ -1,7 +1,12 @@
-"""Metrics middleware: per-request latency histogram.
+"""Metrics middleware: per-request latency histogram + inflight gauge.
 
 Capability parity with ``pkg/gofr/http/middleware/metrics.go:21-42``
-(``app_http_response`` histogram labeled path/method/status).
+(``app_http_response`` histogram labeled path/method/status). Two ISSUE 2
+additions: an escaped handler exception is observed as status=500 before
+re-raising (previously failures bypassed the histogram entirely, so error
+storms were invisible in latency dashboards), and ``app_http_inflight``
+counts requests between arrival and response — the saturation signal a
+rate-of-completions histogram cannot give while requests are stuck.
 """
 
 from __future__ import annotations
@@ -16,7 +21,26 @@ def metrics_middleware(manager: Manager) -> Middleware:
     def middleware(next_handler: WireHandler) -> WireHandler:
         async def handle(request):
             start = time.perf_counter()
-            status, headers, body = await next_handler(request)
+            manager.delta_updown_counter("app_http_inflight", 1.0)
+            inflight_open = True
+
+            def settle() -> None:
+                nonlocal inflight_open
+                if inflight_open:
+                    inflight_open = False
+                    manager.delta_updown_counter("app_http_inflight", -1.0)
+
+            try:
+                status, headers, body = await next_handler(request)
+            except Exception:
+                # the handler layer normally converts failures to a 500
+                # response; anything escaping past it would otherwise
+                # never reach the histogram
+                manager.record_histogram(
+                    "app_http_response", time.perf_counter() - start,
+                    path=request.path, method=request.method, status="500")
+                settle()
+                raise
             from gofr_tpu.http.response import StreamBody
             if isinstance(body, StreamBody):
                 # a stream's latency is its full production time, and a
@@ -28,6 +52,7 @@ def metrics_middleware(manager: Manager) -> Middleware:
                         "app_http_response", time.perf_counter() - start,
                         path=request.path, method=request.method,
                         status=str(status if ok else 500))
+                    settle()
 
                 body.on_complete(observe)
             else:
@@ -36,6 +61,7 @@ def metrics_middleware(manager: Manager) -> Middleware:
                     path=request.path, method=request.method,
                     status=str(status),
                 )
+                settle()
             return status, headers, body
         return handle
     return middleware

@@ -1,4 +1,6 @@
 from gofr_tpu.metrics.manager import Manager, MetricsError, new_manager
 from gofr_tpu.metrics.exposition import render_prometheus
+from gofr_tpu.metrics.digest import WindowedCounter, WindowedDigest
 
-__all__ = ["Manager", "MetricsError", "new_manager", "render_prometheus"]
+__all__ = ["Manager", "MetricsError", "new_manager", "render_prometheus",
+           "WindowedCounter", "WindowedDigest"]

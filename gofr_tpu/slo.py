@@ -1,0 +1,332 @@
+"""SLO accounting: deadlines, goodput vs raw throughput, and a
+degradation watchdog.
+
+The serving question that raw latency histograms cannot answer is "what
+fraction of traffic met its target, and how much of the work the TPU did
+was *useful*?" (goodput — tokens delivered within deadline — vs raw
+tokens/s). This module owns that accounting:
+
+- Requests may carry an ``X-Request-Deadline-Ms`` header (milliseconds of
+  budget from ingress). ``wrap_handler`` converts it to an absolute
+  monotonic instant and stashes it in a contextvar, which survives into
+  async handlers and ``asyncio.to_thread`` — the batcher and generation
+  engine read it at submit time without any signature churn in user code.
+- Each completion is classified ``ok | violated | expired``:
+  ``ok`` finished within deadline (or had none), ``violated`` finished
+  but late, ``expired`` was shed before prefill because its deadline had
+  already passed — spending HBM and flops on it could only produce a
+  response the client stopped waiting for (the drop-expired idiom from
+  the batch-size/latency tradeoff literature, arxiv 1812.11731).
+- :class:`SLOTracker` keeps windowed views (1m/5m) of TTFT quantiles,
+  outcome counts, raw tokens/s and goodput tokens/s, and mirrors each
+  event into the Prometheus catalog (``app_tpu_slo_total{outcome}``,
+  ``app_tpu_tokens_total``, ``app_tpu_goodput_tokens_total``).
+- :class:`Watchdog` periodically evaluates the rolling windows and flips
+  replica health READY -> DEGRADED (with hysteresis, so one bad scrape
+  never flaps a load balancer) when SLO attainment drops or p99 TTFT
+  blows past its ceiling; transitions increment
+  ``app_health_transitions_total`` and surface in ``Container.health()``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import time
+from typing import Any, Dict, Optional
+
+from gofr_tpu.metrics.digest import WindowedCounter, WindowedDigest
+
+OUTCOME_OK = "ok"
+OUTCOME_VIOLATED = "violated"
+OUTCOME_EXPIRED = "expired"
+
+
+class DeadlineExceeded(Exception):
+    """Raised to the caller when a request is shed because its deadline
+    had already passed before any device work started. The HTTP
+    responder duck-types ``status_code``, mapping this to 503 without the
+    TPU layer importing HTTP code."""
+
+    status_code = 503
+
+    def __init__(self, message: str = "request deadline exceeded before execution"):
+        super().__init__(message)
+
+
+# -- deadline propagation (contextvar, set per-request in wrap_handler) ------
+_deadline: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "gofr_tpu_deadline", default=None)
+
+
+def set_request_deadline(budget_ms: Optional[float],
+                         now: Optional[float] = None) -> Optional[float]:
+    """Convert a relative millisecond budget into an absolute monotonic
+    deadline and make it current. Returns the absolute deadline (or None
+    for no/invalid budget)."""
+    if budget_ms is None or budget_ms <= 0:
+        _deadline.set(None)
+        return None
+    now = time.monotonic() if now is None else now
+    deadline = now + budget_ms / 1000.0
+    _deadline.set(deadline)
+    return deadline
+
+
+def current_deadline() -> Optional[float]:
+    """Absolute monotonic deadline of the current request, or None."""
+    return _deadline.get()
+
+
+def parse_deadline_header(raw: str) -> Optional[float]:
+    """``X-Request-Deadline-Ms`` value -> float ms, None when absent or
+    malformed (a bad header must never fail the request)."""
+    if not raw:
+        return None
+    try:
+        budget = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return budget if budget > 0 else None
+
+
+class SLOTracker:
+    """Windowed goodput/latency accounting shared by the batcher, the
+    generation engine, and the admin surfaces (/debug/varz, statusz)."""
+
+    def __init__(self, metrics: Any = None, slice_s: float = 5.0,
+                 max_window_s: float = 300.0):
+        self.metrics = metrics
+        self.ttft = WindowedDigest(alpha=0.01, slice_s=slice_s,
+                                   max_window_s=max_window_s)
+        self.tokens = WindowedCounter(slice_s, max_window_s)
+        self.goodput_tokens = WindowedCounter(slice_s, max_window_s)
+        self.outcomes: Dict[str, WindowedCounter] = {
+            OUTCOME_OK: WindowedCounter(slice_s, max_window_s),
+            OUTCOME_VIOLATED: WindowedCounter(slice_s, max_window_s),
+            OUTCOME_EXPIRED: WindowedCounter(slice_s, max_window_s),
+        }
+
+    # -- event feeds --------------------------------------------------------
+    def record_ttft(self, seconds: float, now: Optional[float] = None) -> None:
+        self.ttft.record(seconds, now=now)
+
+    def record_tokens(self, n: float, now: Optional[float] = None) -> None:
+        """Raw generated tokens, counted as they are produced."""
+        if n > 0:
+            self.tokens.add(n, now=now)
+
+    def classify(self, deadline: Optional[float], finished_at: Optional[float] = None) -> str:
+        finished_at = time.monotonic() if finished_at is None else finished_at
+        if deadline is None:
+            return OUTCOME_OK
+        return OUTCOME_OK if finished_at <= deadline else OUTCOME_VIOLATED
+
+    def record_outcome(self, outcome: str, tokens: float = 0.0,
+                       now: Optional[float] = None) -> None:
+        """One request reached a terminal state. ``tokens`` is the
+        request's total generated tokens; only ``ok`` completions count
+        toward goodput."""
+        counter = self.outcomes.get(outcome)
+        if counter is None:
+            return
+        counter.add(1.0, now=now)
+        if outcome == OUTCOME_OK and tokens > 0:
+            self.goodput_tokens.add(tokens, now=now)
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_tpu_slo_total", outcome=outcome)
+
+    # -- derived views ------------------------------------------------------
+    def attainment(self, window_s: float = 60.0,
+                   now: Optional[float] = None) -> Optional[float]:
+        """Fraction of terminal requests in the window that were ``ok``;
+        None when the window is empty (no data is not bad data)."""
+        now = time.monotonic() if now is None else now
+        ok = self.outcomes[OUTCOME_OK].sum(window_s, now)
+        bad = (self.outcomes[OUTCOME_VIOLATED].sum(window_s, now)
+               + self.outcomes[OUTCOME_EXPIRED].sum(window_s, now))
+        total = ok + bad
+        if total <= 0:
+            return None
+        return ok / total
+
+    def export_gauges(self, window_s: float = 60.0,
+                      now: Optional[float] = None) -> None:
+        """Refresh the windowed-rate gauges in the Prometheus catalog;
+        called on each /metrics scrape (system_metrics_refresh idiom) so
+        the exposed rates always describe the last window, not process
+        lifetime averages."""
+        if self.metrics is None:
+            return
+        now = time.monotonic() if now is None else now
+        self.metrics.set_gauge("app_tpu_tokens_per_s",
+                               self.tokens.rate(window_s, now))
+        self.metrics.set_gauge("app_tpu_goodput_tokens_per_s",
+                               self.goodput_tokens.rate(window_s, now))
+        attainment = self.attainment(window_s, now)
+        if attainment is not None:
+            self.metrics.set_gauge("app_tpu_slo_attainment", attainment)
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        now = time.monotonic() if now is None else now
+        out: Dict[str, Any] = {"ttft_s": self.ttft.snapshot(now=now)}
+        for window in (60.0, 300.0):
+            key = f"{int(window)}s"
+            attainment = self.attainment(window, now)
+            out[key] = {
+                "tokens_per_s": round(self.tokens.rate(window, now), 3),
+                "goodput_tokens_per_s": round(
+                    self.goodput_tokens.rate(window, now), 3),
+                "slo_attainment": (round(attainment, 4)
+                                   if attainment is not None else None),
+                "outcomes": {
+                    name: self.outcomes[name].sum(window, now)
+                    for name in (OUTCOME_OK, OUTCOME_VIOLATED, OUTCOME_EXPIRED)
+                },
+            }
+        out["lifetime"] = {
+            "tokens_total": self.tokens.total(),
+            "goodput_tokens_total": self.goodput_tokens.total(),
+        }
+        return out
+
+
+STATE_READY = "READY"
+STATE_DEGRADED = "DEGRADED"
+
+
+class Watchdog:
+    """Background evaluator that drains a sick replica.
+
+    Every ``interval_s`` it inspects the rolling window; after
+    ``hysteresis`` *consecutive* bad evaluations it flips DEGRADED (and
+    back after the same number of good ones), so a single slow scrape or
+    one recovered window never flaps the load balancer. Windows with
+    fewer than ``min_requests`` terminal requests are treated as healthy
+    — an idle replica is not a sick replica."""
+
+    def __init__(self, slo: SLOTracker, metrics: Any = None,
+                 logger: Any = None, *, min_attainment: float = 0.9,
+                 max_p99_ttft_s: Optional[float] = None,
+                 window_s: float = 60.0, interval_s: float = 5.0,
+                 hysteresis: int = 3, min_requests: int = 1):
+        self.slo = slo
+        self.metrics = metrics
+        self.logger = logger
+        self.min_attainment = min_attainment
+        self.max_p99_ttft_s = max_p99_ttft_s
+        self.window_s = window_s
+        self.interval_s = interval_s
+        self.hysteresis = max(1, int(hysteresis))
+        self.min_requests = max(0, int(min_requests))
+        self.state = STATE_READY
+        self.transitions = 0
+        self._bad_streak = 0
+        self._good_streak = 0
+        self._last_reasons: list = []
+        self._task: Optional[asyncio.Task] = None
+
+    # -- one evaluation (synchronous: unit-testable without a loop) ---------
+    def evaluate(self, now: Optional[float] = None) -> str:
+        now = time.monotonic() if now is None else now
+        reasons = []
+        terminal = sum(self.slo.outcomes[name].sum(self.window_s, now)
+                       for name in (OUTCOME_OK, OUTCOME_VIOLATED,
+                                    OUTCOME_EXPIRED))
+        if terminal >= max(self.min_requests, 1):
+            attainment = self.slo.attainment(self.window_s, now)
+            if attainment is not None and attainment < self.min_attainment:
+                reasons.append(
+                    f"slo_attainment {attainment:.3f} < {self.min_attainment}")
+        if self.max_p99_ttft_s is not None:
+            p99 = self.slo.ttft.quantile(0.99, self.window_s, now)
+            if p99 is not None and p99 > self.max_p99_ttft_s:
+                reasons.append(f"p99_ttft {p99:.3f}s > {self.max_p99_ttft_s}s")
+        self._last_reasons = reasons
+        if reasons:
+            self._bad_streak += 1
+            self._good_streak = 0
+        else:
+            self._good_streak += 1
+            self._bad_streak = 0
+        if (self.state == STATE_READY
+                and self._bad_streak >= self.hysteresis):
+            self._transition(STATE_DEGRADED, reasons)
+        elif (self.state == STATE_DEGRADED
+                and self._good_streak >= self.hysteresis):
+            self._transition(STATE_READY, reasons)
+        return self.state
+
+    def _transition(self, state: str, reasons: list) -> None:
+        previous, self.state = self.state, state
+        self.transitions += 1
+        self._bad_streak = 0
+        self._good_streak = 0
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_health_transitions_total",
+                                           to=state)
+        if self.logger is not None:
+            if state == STATE_DEGRADED:
+                self.logger.warn("watchdog: %s -> %s (%s)", previous, state,
+                                 "; ".join(reasons) or "thresholds crossed")
+            else:
+                self.logger.info("watchdog: %s -> %s (recovered)",
+                                 previous, state)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                self.evaluate()
+            except Exception as exc:  # an accounting bug must not kill the app
+                if self.logger is not None:
+                    self.logger.error("watchdog evaluation failed: %r", exc)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    def statusz(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "transitions": self.transitions,
+            "bad_streak": self._bad_streak,
+            "good_streak": self._good_streak,
+            "last_reasons": list(self._last_reasons),
+            "thresholds": {
+                "min_attainment": self.min_attainment,
+                "max_p99_ttft_s": self.max_p99_ttft_s,
+                "window_s": self.window_s,
+                "hysteresis": self.hysteresis,
+                "min_requests": self.min_requests,
+            },
+        }
+
+
+def new_watchdog(config: Any, slo: SLOTracker, metrics: Any = None,
+                 logger: Any = None) -> Optional[Watchdog]:
+    """Config-driven factory. Returns None when disabled
+    (``SLO_WATCHDOG_ENABLED=false``). ``SLO_MAX_P99_TTFT_MS`` unset means
+    the TTFT ceiling check is off; attainment defaults to 0.9."""
+    if not config.get_bool("SLO_WATCHDOG_ENABLED", True):
+        return None
+    max_ttft_ms = config.get_float("SLO_MAX_P99_TTFT_MS", 0.0)
+    return Watchdog(
+        slo, metrics=metrics, logger=logger,
+        min_attainment=config.get_float("SLO_MIN_ATTAINMENT", 0.9),
+        max_p99_ttft_s=(max_ttft_ms / 1000.0) if max_ttft_ms > 0 else None,
+        window_s=config.get_float("SLO_WINDOW_S", 60.0),
+        interval_s=config.get_float("SLO_WATCHDOG_INTERVAL_S", 5.0),
+        hysteresis=int(config.get_float("SLO_WATCHDOG_HYSTERESIS", 3)),
+        min_requests=int(config.get_float("SLO_WATCHDOG_MIN_REQUESTS", 1)),
+    )

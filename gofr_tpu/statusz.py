@@ -29,6 +29,15 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
         },
     }
 
+    # SLO & watchdog view (ISSUE 2): windowed goodput and the degradation
+    # state machine next to the queues they explain
+    slo = getattr(container, "slo", None)
+    if slo is not None:
+        status["slo"] = slo.snapshot()
+    watchdog = getattr(container, "watchdog", None)
+    if watchdog is not None:
+        status["watchdog"] = watchdog.statusz()
+
     batcher = getattr(container, "tpu_batcher", None)
     if batcher is not None:
         status["batcher"] = {
@@ -48,6 +57,12 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
         recorder = getattr(tpu, "recorder", None)
         if recorder is not None and "engine" not in status:
             status["requests"] = recorder.snapshot(limit=recent)
+        saturation_fn = getattr(tpu, "saturation", None)
+        if saturation_fn is not None:   # Executor duty-cycle/MFU/HBM view
+            try:
+                status["saturation"] = saturation_fn()
+            except Exception as exc:
+                status["saturation"] = {"error": repr(exc)}
 
     return status
 

@@ -26,31 +26,36 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gofr_tpu.slo import DeadlineExceeded, current_deadline
 from gofr_tpu.trace import Span, current_span
 
 
 class _Pending:
-    __slots__ = ("examples", "futures", "spans", "timer")
+    __slots__ = ("examples", "futures", "spans", "deadlines", "timer")
 
     def __init__(self):
         self.examples: List[Any] = []
         self.futures: List[asyncio.Future] = []
         self.spans: List[Optional[Span]] = []   # queue.wait span per example
+        self.deadlines: List[Optional[float]] = []  # abs monotonic, or None
         self.timer: Optional[asyncio.TimerHandle] = None
 
 
 class DynamicBatcher:
     def __init__(self, executor, max_batch: int = 32,
-                 max_delay_ms: float = 2.0, logger=None, tracer=None):
+                 max_delay_ms: float = 2.0, logger=None, tracer=None,
+                 slo=None):
         self.executor = executor
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1000.0
         self.logger = logger
         self.tracer = tracer
+        self.slo = slo  # SLOTracker (goodput/outcome accounting), optional
         self._pending: Dict[str, _Pending] = {}
 
     async def predict(self, name: str, example: Any) -> Any:
@@ -67,6 +72,9 @@ class DynamicBatcher:
         pending.examples.append(example)
         pending.futures.append(future)
         pending.spans.append(span)
+        # the request's deadline rides with the example: checked again at
+        # flush time, after queue wait has eaten part of the budget
+        pending.deadlines.append(current_deadline())
         if len(pending.examples) >= self.max_batch:
             self._flush(name)
         elif pending.timer is None:
@@ -92,12 +100,45 @@ class DynamicBatcher:
                 span.set_attribute("batch_size", len(pending.examples))
                 span.finish()
         asyncio.ensure_future(self._run(name, pending.examples,
-                                        pending.futures, pending.spans))
+                                        pending.futures, pending.spans,
+                                        pending.deadlines))
+
+    def _shed_expired(self, name: str, examples: List[Any],
+                      futures: List[asyncio.Future],
+                      spans: List[Optional[Span]],
+                      deadlines: List[Optional[float]]):
+        """Drop examples whose deadline already passed — executing them
+        burns a device step on an answer nobody is waiting for. Returns
+        the still-live (examples, futures, deadlines)."""
+        now = time.monotonic()
+        live = []
+        for example, future, span, deadline in zip(examples, futures, spans,
+                                                   deadlines):
+            if deadline is not None and now > deadline:
+                if not future.done():
+                    future.set_exception(DeadlineExceeded())
+                if self.slo is not None:
+                    self.slo.record_outcome("expired")
+                if self.logger is not None:
+                    self.logger.warn("tpu batch %s: shed expired request "
+                                     "(%.1fms past deadline)", name,
+                                     (now - deadline) * 1000.0)
+            else:
+                live.append((example, future, span, deadline))
+        return live
 
     async def _run(self, name: str, examples: List[Any],
                    futures: List[asyncio.Future],
-                   spans: List[Optional[Span]]) -> None:
+                   spans: List[Optional[Span]],
+                   deadlines: List[Optional[float]]) -> None:
         loop = asyncio.get_running_loop()
+        live = self._shed_expired(name, examples, futures, spans, deadlines)
+        if not live:
+            return
+        examples = [entry[0] for entry in live]
+        futures = [entry[1] for entry in live]
+        spans = [entry[2] for entry in live]
+        deadlines = [entry[3] for entry in live]
         step_span = None
         if self.tracer is not None:
             # root span for the fused device step, linked to every request
@@ -129,10 +170,14 @@ class DynamicBatcher:
                     ctx = contextvars.copy_context()
                     result = await loop.run_in_executor(
                         None, ctx.run, self.executor.predict, name, batch)
+            finished_at = time.monotonic()
             for i, future in enumerate(futures):
                 if not future.done():  # request may have timed out/gone
                     future.set_result(
                         jax.tree.map(lambda l: np.asarray(l)[i], result))
+                if self.slo is not None:
+                    self.slo.record_outcome(
+                        self.slo.classify(deadlines[i], finished_at))
         except Exception as exc:
             if self.logger is not None:
                 self.logger.error("tpu batch %s failed: %r", name, exc)

@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from gofr_tpu.metrics.digest import WindowedCounter
+
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
 
@@ -59,7 +61,7 @@ class Executor:
     """
 
     def __init__(self, logger, metrics, mesh=None, batch_axis: str = "dp",
-                 donate_cache: bool = False):
+                 donate_cache: bool = False, peak_flops: float = 0.0):
         import jax
         self._jax = jax
         self.logger = logger
@@ -69,6 +71,15 @@ class Executor:
         self._models: Dict[str, _Model] = {}
         self.devices = jax.devices()
         self._up = {d.id: True for d in self.devices}
+        # saturation accounting: windowed device-busy seconds and executed
+        # FLOPs feed duty-cycle and MFU; peak_flops (TPU_PEAK_FLOPS, whole
+        # slice) of 0 means "unknown hardware" and disables the MFU ratio
+        self.peak_flops = float(peak_flops)
+        self._busy_s = WindowedCounter()
+        self._flops_done = WindowedCounter()
+        # cost_analysis FLOPs per (model, bucket); None = analysis
+        # unavailable on this backend, don't retry every step
+        self._flops_cache: Dict[Tuple[str, int], Optional[float]] = {}
 
     # -- registration (analog of datasource connect) ------------------------
     def register(self, name: str, fn: Callable, params: Any,
@@ -170,12 +181,12 @@ class Executor:
         padded = self._tree_unflatten(
             inputs, [_pad_batch(np.asarray(l), bucket) for l in leaves])
         out = self._execute_async(model, padded, bucket)
-        return (name, out, n, start, span)
+        return (name, out, n, start, span, bucket)
 
     def fetch(self, handle) -> Any:
         """Sync a ``dispatch`` handle: wait for the execute, record metrics,
         slice off the padding."""
-        name, out, n, start, span = handle
+        name, out, n, start, span, bucket = handle
         out = self._jax.block_until_ready(out)
         elapsed = time.perf_counter() - start
         exemplar = ({"trace_id": span.trace_id} if span is not None else None)
@@ -184,7 +195,84 @@ class Executor:
         self.metrics.record_histogram("app_tpu_batch_size", float(n),
                                       model=name)
         self.metrics.increment_counter("app_tpu_requests_total", model=name)
+        self._busy_s.add(elapsed)
+        flops = self._bucket_flops(name, bucket)
+        if flops:
+            self._flops_done.add(flops)
         return self._jax.tree.map(lambda l: np.asarray(l)[:n], out)
+
+    # -- saturation telemetry ------------------------------------------------
+    def note_execution(self, seconds: float, flops: float = 0.0) -> None:
+        """Feed device-busy wall time (and optionally FLOPs) executed
+        outside the dispatch/fetch path — the generation engine's prefill
+        and decode steps run their own executables but count toward the
+        same duty cycle."""
+        if seconds > 0:
+            self._busy_s.add(seconds)
+        if flops > 0:
+            self._flops_done.add(flops)
+
+    def _bucket_flops(self, name: str, bucket: int) -> Optional[float]:
+        """FLOPs of one compiled (model, bucket) execution, from XLA's
+        ``cost_analysis`` — computed once and cached; None when the
+        backend doesn't expose it (then MFU stays unreported rather than
+        lying)."""
+        key = (name, bucket)
+        if key in self._flops_cache:
+            return self._flops_cache[key]
+        flops: Optional[float] = None
+        model = self._models.get(name)
+        compiled = model.compiled.get(bucket) if model is not None else None
+        if compiled is not None:
+            try:
+                analysis = compiled.cost_analysis()
+                if isinstance(analysis, (list, tuple)):
+                    analysis = analysis[0] if analysis else {}
+                value = float(analysis.get("flops", 0.0))
+                flops = value if value > 0 else None
+            except Exception:
+                flops = None
+        self._flops_cache[key] = flops
+        return flops
+
+    def saturation(self, window_s: float = 60.0) -> Dict[str, Any]:
+        """Windowed device-saturation view: duty cycle (busy seconds per
+        wall second — can exceed 1.0 when dispatches overlap), achieved
+        FLOP/s, MFU against ``TPU_PEAK_FLOPS``, and HBM occupancy."""
+        busy = self._busy_s.sum(window_s)
+        duty = busy / max(window_s, 1e-9)
+        flops_per_s = self._flops_done.rate(window_s)
+        mfu = (flops_per_s / self.peak_flops) if self.peak_flops > 0 else None
+        hbm: Dict[str, Any] = {}
+        for device in self.devices:
+            try:
+                mem = device.memory_stats() or {}
+            except Exception:
+                continue
+            in_use = float(mem.get("bytes_in_use", 0))
+            limit = float(mem.get("bytes_limit", 0))
+            hbm[str(device.id)] = {
+                "bytes_in_use": in_use,
+                "bytes_limit": limit,
+                "occupancy": round(in_use / limit, 4) if limit > 0 else None,
+            }
+        out = {
+            "window_s": window_s,
+            "busy_s": round(busy, 4),
+            "duty_cycle": round(duty, 4),
+            "flops_per_s": flops_per_s,
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "peak_flops": self.peak_flops or None,
+            "hbm": hbm,
+        }
+        self.metrics.set_gauge("app_tpu_duty_cycle", min(duty, 1.0))
+        if mfu is not None:
+            self.metrics.set_gauge("app_tpu_mfu", mfu)
+        for device_id, entry in hbm.items():
+            if entry["occupancy"] is not None:
+                self.metrics.set_gauge("app_tpu_hbm_occupancy",
+                                       entry["occupancy"], device=device_id)
+        return out
 
     def _execute(self, model: _Model, padded: Any, bucket: int) -> Any:
         return self._jax.block_until_ready(
@@ -277,4 +365,5 @@ def new_executor(config, logger, metrics) -> Executor:
             axis, _, size = part.partition(":")
             axes[axis.strip()] = int(size)
         mesh = make_mesh(axes)
-    return Executor(logger, metrics, mesh=mesh)
+    peak_flops = config.get_float("TPU_PEAK_FLOPS", 0.0) if config else 0.0
+    return Executor(logger, metrics, mesh=mesh, peak_flops=peak_flops)

@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from gofr_tpu.slo import DeadlineExceeded, current_deadline
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.trace import Span, current_span
 
@@ -144,24 +145,29 @@ class _Flight:
     """Per-request observability context threaded from submit to finish:
     the span identifying the request (the HTTP request span when the call
     came through the middleware, else the ``queue.wait`` span's trace), the
-    open ``queue.wait`` span, and the flight-recorder record."""
-    __slots__ = ("link_span", "qspan", "record")
+    open ``queue.wait`` span, and the flight-recorder record. Also carries
+    the request's absolute deadline (monotonic seconds, None = no SLO)
+    captured at submit time — admission re-checks it so a request whose
+    budget was eaten by queue wait is shed before prefill."""
+    __slots__ = ("link_span", "qspan", "record", "deadline")
 
     def __init__(self, link_span: Optional[Span], qspan: Optional[Span],
-                 record: RequestRecord):
+                 record: RequestRecord, deadline: Optional[float] = None):
         self.link_span = link_span
         self.qspan = qspan
         self.record = record
+        self.deadline = deadline
 
 
 class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
                  "inflight", "queue", "temperature", "fill", "submitted_at",
-                 "record", "req_span", "phase_span")
+                 "deadline", "record", "req_span", "phase_span")
 
     def __init__(self):
         self.future: Optional[asyncio.Future] = None
         self.submitted_at = 0.0    # request submit time → TTFT histogram
+        self.deadline: Optional[float] = None  # abs monotonic SLO deadline
         self.remaining = 0
         self.eos_id: Optional[int] = None
         self.tokens: List[int] = []
@@ -201,7 +207,8 @@ class GenerationEngine:
                  max_inflight_ticks: int = 2,
                  mesh=None,
                  window_ladder: bool = True,
-                 logger=None, metrics=None, tracer=None, recorder=None):
+                 logger=None, metrics=None, tracer=None, recorder=None,
+                 slo=None):
         import jax
         import jax.numpy as jnp
 
@@ -253,6 +260,7 @@ class GenerationEngine:
         self.metrics = metrics
         self.tracer = tracer   # None → span emission off, recorder still on
         self.recorder: FlightRecorder = recorder or FlightRecorder()
+        self.slo = slo         # SLOTracker: goodput/outcome accounting
 
         if mesh is not None:
             from gofr_tpu.ops.quant import quantized_specs
@@ -600,7 +608,9 @@ class GenerationEngine:
             trace_id=link_span.trace_id if link_span is not None else None,
             span_id=link_span.span_id if link_span is not None else None)
         self.recorder.start(record)
-        return _Flight(link_span, qspan, record)
+        # the submitting context's deadline (X-Request-Deadline-Ms) rides
+        # with the flight — checked again at admission time
+        return _Flight(link_span, qspan, record, deadline=current_deadline())
 
     async def generate(self, prompt_ids, max_new_tokens: int,
                        eos_id: Optional[int] = None,
@@ -889,6 +899,27 @@ class GenerationEngine:
                     flight.qspan.finish()
                 self.recorder.finish(flight.record, "cancelled")
                 continue
+            if (flight.deadline is not None
+                    and time.monotonic() > flight.deadline):
+                # deadline ate the whole budget in the admission queue:
+                # shed before prefill — a late answer is wasted HBM+flops
+                exc = DeadlineExceeded()
+                if not future.done():
+                    future.set_exception(exc)
+                if queue is not None:
+                    queue.put_nowait(exc)
+                if flight.qspan is not None:
+                    flight.qspan.set_status("EXPIRED")
+                    flight.qspan.finish()
+                self.recorder.finish(flight.record, "expired")
+                if self.slo is not None:
+                    self.slo.record_outcome("expired")
+                if self.logger is not None:
+                    self.logger.warn(
+                        "engine: shed expired request before prefill "
+                        "(%.1fms past deadline)",
+                        (time.monotonic() - flight.deadline) * 1000.0)
+                continue
             by_bucket.setdefault(bucket, []).append(
                 (prompt, budget, eos_id, sampling, future, queue,
                  submitted_at, flight))
@@ -917,6 +948,7 @@ class GenerationEngine:
                 slot = self._slots[slot_idx]
                 slot.future = future
                 slot.submitted_at = submitted_at
+                slot.deadline = flight.deadline
                 slot.remaining = budget
                 slot.eos_id = eos_id
                 slot.tokens = []
@@ -1105,13 +1137,16 @@ class GenerationEngine:
             # so no decode tick is included)
             if slot.record is not None:
                 slot.record.first_token()
+            ttft = time.monotonic() - slot.submitted_at
             if self.metrics is not None:
                 self.metrics.record_histogram(
-                    "app_tpu_ttft", time.monotonic() - slot.submitted_at,
+                    "app_tpu_ttft", ttft,
                     exemplar=({"trace_id": slot.record.trace_id}
                               if slot.record is not None
                               and slot.record.trace_id else None),
                     model="generate")
+            if self.slo is not None:
+                self.slo.record_ttft(ttft)
             # prefill phase ends at the first token; decode begins
             if slot.phase_span is not None:
                 slot.phase_span.finish()
@@ -1125,12 +1160,21 @@ class GenerationEngine:
             slot.remaining -= 1
             if slot.record is not None:
                 slot.record.tokens += 1
+            if self.slo is not None:
+                self.slo.record_tokens(1)   # raw throughput, as produced
             if slot.queue is not None:
                 slot.queue.put_nowait(token)
             if (slot.remaining <= 0
                     or (slot.eos_id is not None and token == slot.eos_id)):
                 slot.active = False    # rest of the chunk is discarded
                 self._free.append(slot_idx)
+                if self.slo is not None:
+                    # terminal classification: within deadline (or no
+                    # deadline) → ok and its tokens count as goodput;
+                    # late → violated (work done, value lost)
+                    self.slo.record_outcome(
+                        self.slo.classify(slot.deadline),
+                        tokens=float(len(slot.tokens)))
                 self._finish_slot(slot, "done")
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_result(list(slot.tokens))

@@ -1,0 +1,105 @@
+"""ISSUE 2 satellite coverage: the metrics middleware's inflight gauge and
+escaped-exception accounting, and per-job cron duration/outcome metrics."""
+
+import asyncio
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.cron import CronJob, Crontab
+from gofr_tpu.http.middleware.metrics import metrics_middleware
+from gofr_tpu.http.response import Stream
+
+from tests.util import http_request, make_app, parse_sse, run, serving
+
+
+class _FakeRequest:
+    path = "/boom"
+    method = "GET"
+
+
+def test_middleware_observes_escaped_exception_as_500():
+    """An exception escaping the handler layer entirely (normally it is
+    converted to a 500 response before reaching middleware) must still hit
+    the latency histogram and release the inflight gauge."""
+    container = new_mock_container()
+    manager = container.metrics
+
+    async def exploding(request):
+        raise RuntimeError("kaboom")
+
+    handle = metrics_middleware(manager)(exploding)
+
+    async def main():
+        try:
+            await handle(_FakeRequest())
+        except RuntimeError:
+            return True
+        return False
+
+    assert asyncio.run(main())
+    assert manager.value("app_http_response", path="/boom", method="GET",
+                         status="500") == 1
+    assert manager.value("app_http_inflight") == 0.0
+
+
+def test_inflight_gauge_rises_and_settles():
+    """app_http_inflight counts requests between arrival and response —
+    observed mid-request from inside the handler, and back at zero after
+    every outcome class including streams."""
+    async def main():
+        app = make_app()
+        metrics = app.container.metrics
+        seen = {}
+
+        async def slow(ctx):
+            seen["inflight"] = metrics.value("app_http_inflight")
+            return {"ok": True}
+
+        async def panic(ctx):
+            raise RuntimeError("kaboom")
+
+        async def stream(ctx):
+            async def frames():
+                for i in range(2):
+                    yield str(i)
+            return Stream(frames(), sse=True)
+
+        app.get("/slow", slow)
+        app.get("/panic", panic)
+        app.get("/stream", stream)
+        async with serving(app) as port:
+            assert (await http_request(port, "GET", "/slow")).status == 200
+            assert seen["inflight"] == 1.0
+            assert (await http_request(port, "GET", "/panic")).status == 500
+            result = await http_request(port, "GET", "/stream")
+            assert parse_sse(result.body) == ["0", "1"]
+            await asyncio.sleep(0.05)   # stream observer fires on close
+        assert metrics.value("app_http_inflight") == 0.0
+    run(main())
+
+
+def test_cron_job_metrics_success_and_failure():
+    container = new_mock_container()
+    crontab = Crontab(container)
+
+    async def good(ctx):
+        return None
+
+    def bad(ctx):
+        raise RuntimeError("nightly job fell over")
+
+    async def main():
+        await crontab._run_job(CronJob("* * * * *", "good", good))
+        await crontab._run_job(CronJob("* * * * *", "good", good))
+        await crontab._run_job(CronJob("* * * * *", "bad", bad))
+
+    asyncio.run(main())
+    metrics = container.metrics
+    assert metrics.value("app_cron_runs_total", job="good",
+                         result="success") == 2
+    assert metrics.value("app_cron_runs_total", job="good",
+                         result="failure") is None
+    assert metrics.value("app_cron_runs_total", job="bad",
+                         result="failure") == 1
+    # the duration histogram observes every firing, success or not
+    assert metrics.value("app_cron_duration", job="good") == 2
+    assert metrics.value("app_cron_duration", job="bad") == 1

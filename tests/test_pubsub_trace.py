@@ -1,0 +1,165 @@
+"""Trace propagation through pub/sub (ISSUE 2 satellite): publish inside a
+span injects a W3C ``traceparent`` the consumer side surfaces as a message
+header, and the subscriber loop continues the publisher's trace with a
+``pubsub.consume`` span — same trace_id end-to-end, across processes.
+
+Kafka's message-set v1 wire format has no record headers, so its carrier
+is the opt-in byte envelope from ``datasource/pubsub/base.py`` — applied
+ONLY when a span is active at publish time, keeping the raw wire payload
+byte-identical for untraced publishes (asserted against the fake broker's
+log).
+"""
+
+import asyncio
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.datasource.pubsub.base import (
+    decode_trace_envelope,
+    encode_trace_envelope,
+)
+from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
+from gofr_tpu.trace import ListExporter, Tracer, extract_traceparent
+from tests.test_pubsub_wire import FakeKafkaBroker
+
+
+# -- envelope codec ----------------------------------------------------------
+
+def test_envelope_roundtrip():
+    header = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    wrapped = encode_trace_envelope(header, b'{"n": 1}')
+    assert wrapped != b'{"n": 1}'
+    got_header, payload = decode_trace_envelope(wrapped)
+    assert got_header == header
+    assert payload == b'{"n": 1}'
+
+
+def test_envelope_decode_is_safe_on_arbitrary_bytes():
+    for raw in (b"", b'{"n": 1}', b"\x00", b"\x00GTR1", b"\x00GTR1\xff\xff",
+                b"\x00GTR1\x00\x10short"):
+        header, payload = decode_trace_envelope(raw)
+        assert header is None
+        assert payload == raw
+
+
+# -- inmem broker ------------------------------------------------------------
+
+def test_inmem_publish_injects_traceparent_header():
+    container = new_mock_container()
+    exporter = ListExporter()
+    tracer = Tracer(exporter=exporter)
+    broker = InMemoryBroker(container.logger, container.metrics,
+                            tracer=tracer)
+
+    async def main():
+        broker.publish("orders", b'{"n": 1}')
+        message = await asyncio.wait_for(broker.subscribe("orders"), 5.0)
+        return message
+
+    message = asyncio.run(main())
+    assert message.value == b'{"n": 1}'
+    remote = extract_traceparent(message.header("traceparent"))
+    assert remote is not None
+    tracer.shutdown()
+    publishes = exporter.find("pubsub.publish")
+    assert len(publishes) == 1
+    assert publishes[0].trace_id == remote["trace_id"]
+    assert publishes[0].span_id == remote["span_id"]
+    assert publishes[0].attributes["topic"] == "orders"
+
+
+def test_inmem_subscriber_loop_continues_publishers_trace():
+    """End-to-end through App: publish → broker header → subscriber loop's
+    pubsub.consume span shares the publisher's trace_id."""
+    from gofr_tpu.app import App
+
+    container = new_mock_container()
+    exporter = ListExporter()
+    container.tracer = Tracer(exporter=exporter)
+    container.pubsub = InMemoryBroker(container.logger, container.metrics,
+                                      tracer=container.tracer)
+    app = App(config=container.config, container=container)
+    app.http_port = 0
+    app.metrics_port = 0
+
+    handled = asyncio.Event()
+
+    def on_order(ctx):
+        handled.set()
+
+    app.subscribe("orders", on_order)
+
+    async def main():
+        await app.start()
+        try:
+            container.pubsub.publish("orders", b'{"n": 7}')
+            await asyncio.wait_for(handled.wait(), 10.0)
+        finally:
+            await app.stop()
+
+    asyncio.run(main())
+    publishes = exporter.find("pubsub.publish")
+    consumes = exporter.find("pubsub.consume")
+    assert len(publishes) == 1
+    assert consumes, "subscriber loop opened no pubsub.consume span"
+    assert consumes[0].trace_id == publishes[0].trace_id
+    assert consumes[0].parent_id == publishes[0].span_id
+    assert consumes[0].attributes["topic"] == "orders"
+
+
+# -- kafka wire client -------------------------------------------------------
+
+@pytest.fixture()
+def traced_kafka_client():
+    from gofr_tpu.datasource.pubsub.kafka import KafkaClient
+
+    broker = FakeKafkaBroker()
+    container = new_mock_container()
+    exporter = ListExporter()
+    tracer = Tracer(exporter=exporter)
+    client = KafkaClient(
+        MapConfig({"PUBSUB_BROKER": f"127.0.0.1:{broker.port}",
+                   "CONSUMER_ID": "workers",
+                   "KAFKA_FETCH_MAX_WAIT_MS": "20"}),
+        container.logger, container.metrics, tracer=tracer)
+    yield client, broker, tracer, exporter
+    client.close()
+    broker.stop()
+
+
+def test_kafka_untraced_publish_keeps_wire_payload_raw(traced_kafka_client):
+    client, broker, _, _ = traced_kafka_client
+    client.publish("orders", b'{"n": 1}')
+    # no active span at publish time → no envelope, raw bytes on the wire
+    assert broker.logs[("orders", 0)] == [(b"", b'{"n": 1}')]
+
+
+def test_kafka_traced_publish_envelopes_and_consumer_unwraps(
+        traced_kafka_client):
+    client, broker, tracer, exporter = traced_kafka_client
+    with tracer.start_span("handler") as parent:
+        client.publish("orders", b'{"n": 2}')
+    # the wire payload is enveloped (magic prefix), not the raw bytes
+    wire_value = broker.logs[("orders", 0)][0][1]
+    assert wire_value.startswith(b"\x00GTR1")
+    assert wire_value != b'{"n": 2}'
+
+    async def scenario():
+        return await asyncio.wait_for(client.subscribe("orders"), 5.0)
+
+    message = asyncio.run(scenario())
+    # the consumer sees the original payload plus the traceparent header
+    assert message.value == b'{"n": 2}'
+    assert message.bind() == {"n": 2}
+    remote = extract_traceparent(message.header("traceparent"))
+    assert remote is not None
+    assert remote["trace_id"] == parent.trace_id
+    tracer.shutdown()
+    publishes = exporter.find("pubsub.publish")
+    assert len(publishes) == 1
+    assert publishes[0].trace_id == parent.trace_id
+    assert publishes[0].parent_id == parent.span_id
+    assert publishes[0].attributes["backend"] == "KAFKA"
+    assert remote["span_id"] == publishes[0].span_id

@@ -1,0 +1,470 @@
+"""SLO & saturation observability (ISSUE 2): windowed quantile digest,
+deadline-aware goodput accounting, expired-request shedding, the
+degradation watchdog's hysteresis, /debug/varz, and the metric-name lint.
+
+Digest/watchdog tests drive the clock explicitly (every API takes ``now``)
+so window expiry and hysteresis are deterministic; the acceptance scenario
+runs the real generation engine where the only timing assumption is that a
+fresh engine cannot trace+compile+generate inside 50ms.
+"""
+
+import asyncio
+import json
+import random
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.metrics.digest import WindowedCounter, WindowedDigest
+from gofr_tpu.models import llama
+from gofr_tpu.slo import (
+    DeadlineExceeded,
+    SLOTracker,
+    Watchdog,
+    current_deadline,
+    new_watchdog,
+    parse_deadline_header,
+    set_request_deadline,
+)
+from gofr_tpu.tpu.generate import GenerationEngine
+from tests.util import http_request, make_app, run, serving
+
+
+# -- windowed digest ---------------------------------------------------------
+
+class TestWindowedDigest:
+    def test_quantiles_within_relative_error_of_sorted_reference(self):
+        digest = WindowedDigest(alpha=0.01)
+        rng = random.Random(42)
+        values = [rng.lognormvariate(0.0, 1.5) for _ in range(20000)]
+        now = 1000.0
+        for value in values:
+            digest.record(value, now=now)
+        ordered = sorted(values)
+        for q in (0.5, 0.9, 0.95, 0.99):
+            true = ordered[int(q * (len(ordered) - 1))]
+            got = digest.quantile(q, window_s=60.0, now=now)
+            assert got is not None
+            assert abs(got - true) / true <= 0.02, (q, got, true)
+
+    def test_empty_window_returns_none(self):
+        digest = WindowedDigest()
+        assert digest.quantile(0.99, now=100.0) is None
+        assert digest.count(now=100.0) == 0
+
+    def test_samples_age_out_of_the_window(self):
+        digest = WindowedDigest(slice_s=5.0, max_window_s=300.0)
+        for i in range(100):
+            digest.record(1.0, now=10.0 + i * 0.01)
+        assert digest.count(window_s=60.0, now=11.0) == 100
+        # 60s later the samples left the 1m window but live in the 5m one
+        assert digest.count(window_s=60.0, now=80.0) == 0
+        assert digest.quantile(0.99, window_s=60.0, now=80.0) is None
+        assert digest.count(window_s=300.0, now=80.0) == 100
+        # past the max window they are gone entirely (ring expired)
+        assert digest.count(window_s=300.0, now=400.0) == 0
+
+    def test_windows_separate_old_from_new(self):
+        digest = WindowedDigest(slice_s=5.0)
+        for _ in range(50):
+            digest.record(1.0, now=10.0)
+        for _ in range(50):
+            digest.record(100.0, now=290.0)
+        # 1m window at t=300 sees only the late cohort
+        p50_1m = digest.quantile(0.5, window_s=60.0, now=300.0)
+        assert abs(p50_1m - 100.0) / 100.0 <= 0.02
+        # 5m window sees both cohorts; median straddles the early one
+        p25_5m = digest.quantile(0.25, window_s=300.0, now=300.0)
+        assert abs(p25_5m - 1.0) / 1.0 <= 0.02
+
+    def test_underflow_and_bounded_bins(self):
+        digest = WindowedDigest(min_value=1e-3, max_bins=16)
+        now = 50.0
+        for i in range(1000):
+            digest.record(10.0 ** ((i % 100) - 50), now=now)
+        slc = digest._slices[-1]
+        assert len(slc.bins) <= 16
+        assert slc.underflow > 0
+        assert digest.count(now=now) == 1000
+        assert digest.quantile(0.01, now=now) == pytest.approx(1e-3)
+
+    def test_windowed_counter_rates_and_lifetime_total(self):
+        counter = WindowedCounter(slice_s=5.0, max_window_s=300.0)
+        counter.add(120.0, now=10.0)
+        counter.add(60.0, now=200.0)
+        assert counter.sum(window_s=60.0, now=205.0) == 60.0
+        assert counter.rate(window_s=60.0, now=205.0) == pytest.approx(1.0)
+        assert counter.sum(window_s=300.0, now=205.0) == 180.0
+        assert counter.total() == 180.0          # lifetime, never expires
+        assert counter.sum(window_s=300.0, now=600.0) == 0.0
+
+
+# -- deadline plumbing -------------------------------------------------------
+
+class TestDeadline:
+    def test_parse_header(self):
+        assert parse_deadline_header("") is None
+        assert parse_deadline_header("banana") is None
+        assert parse_deadline_header("-5") is None
+        assert parse_deadline_header("0") is None
+        assert parse_deadline_header("250") == 250.0
+        assert parse_deadline_header("1.5") == 1.5
+
+    def test_set_request_deadline_is_absolute_monotonic(self):
+        assert set_request_deadline(None) is None
+        assert current_deadline() is None
+        deadline = set_request_deadline(500.0, now=100.0)
+        assert deadline == pytest.approx(100.5)
+        assert current_deadline() == pytest.approx(100.5)
+        set_request_deadline(None)
+        assert current_deadline() is None
+
+
+# -- SLO tracker -------------------------------------------------------------
+
+class TestSLOTracker:
+    def test_classify(self):
+        slo = SLOTracker()
+        assert slo.classify(None, finished_at=999.0) == "ok"
+        assert slo.classify(100.0, finished_at=99.0) == "ok"
+        assert slo.classify(100.0, finished_at=101.0) == "violated"
+
+    def test_goodput_counts_only_ok_tokens(self):
+        container = new_mock_container()
+        slo = SLOTracker(container.metrics)
+        now = 30.0
+        slo.record_outcome("ok", tokens=100.0, now=now)
+        slo.record_outcome("violated", tokens=40.0, now=now)
+        slo.record_outcome("expired", now=now)
+        assert slo.tokens.total() == 0.0          # raw fed separately
+        assert slo.goodput_tokens.total() == 100.0
+        metrics = container.metrics
+        assert metrics.value("app_tpu_slo_total", outcome="ok") == 1.0
+        assert metrics.value("app_tpu_slo_total", outcome="violated") == 1.0
+        assert metrics.value("app_tpu_slo_total", outcome="expired") == 1.0
+        assert slo.attainment(60.0, now=now) == pytest.approx(1.0 / 3.0)
+
+    def test_attainment_none_on_empty_window(self):
+        slo = SLOTracker()
+        assert slo.attainment(60.0, now=10.0) is None
+
+    def test_export_gauges_and_snapshot(self):
+        container = new_mock_container()
+        slo = SLOTracker(container.metrics)
+        now = 30.0
+        slo.record_ttft(0.12, now=now)
+        slo.record_tokens(600, now=now)
+        slo.record_outcome("ok", tokens=300.0, now=now)
+        slo.record_outcome("violated", tokens=300.0, now=now)
+        slo.export_gauges(60.0, now=now)
+        metrics = container.metrics
+        assert metrics.value("app_tpu_tokens_per_s") == pytest.approx(10.0)
+        assert metrics.value(
+            "app_tpu_goodput_tokens_per_s") == pytest.approx(5.0)
+        assert metrics.value("app_tpu_slo_attainment") == pytest.approx(0.5)
+        snap = slo.snapshot(now=now)
+        assert snap["ttft_s"]["60s"]["p99"] == pytest.approx(0.12, rel=0.02)
+        assert snap["60s"]["tokens_per_s"] == pytest.approx(10.0)
+        assert snap["60s"]["goodput_tokens_per_s"] == pytest.approx(5.0)
+        assert snap["60s"]["outcomes"] == {"ok": 1.0, "violated": 1.0,
+                                           "expired": 0.0}
+        assert snap["lifetime"]["tokens_total"] == 600.0
+
+
+# -- watchdog hysteresis -----------------------------------------------------
+
+class TestWatchdog:
+    def _sick_then_recovered(self, slo, t_bad, t_good):
+        for _ in range(20):
+            slo.record_outcome("violated", now=t_bad)
+        for _ in range(20):
+            slo.record_outcome("ok", tokens=1.0, now=t_good)
+
+    def test_degrades_and_recovers_exactly_once_each(self):
+        """The acceptance state machine: induced slowdown → one READY→
+        DEGRADED transition, recovery → one DEGRADED→READY, no flapping."""
+        container = new_mock_container()
+        slo = SLOTracker(container.metrics)
+        dog = Watchdog(slo, metrics=container.metrics,
+                       logger=container.logger, min_attainment=0.9,
+                       window_s=60.0, hysteresis=3)
+        # slowdown at t=100: every outcome violated
+        self._sick_then_recovered(slo, t_bad=100.0, t_good=400.0)
+        states = [dog.evaluate(now=105.0 + i) for i in range(5)]
+        # hysteresis: two bad evaluations are not enough, the third flips
+        assert states == ["READY", "READY", "DEGRADED", "DEGRADED",
+                          "DEGRADED"]
+        # recovery at t=400 (bad window long expired): three good evals
+        states = [dog.evaluate(now=405.0 + i) for i in range(5)]
+        assert states == ["DEGRADED", "DEGRADED", "READY", "READY", "READY"]
+        assert dog.transitions == 2
+        metrics = container.metrics
+        assert metrics.value("app_health_transitions_total",
+                             to="DEGRADED") == 1.0
+        assert metrics.value("app_health_transitions_total",
+                             to="READY") == 1.0
+
+    def test_streak_resets_prevent_flapping(self):
+        slo = SLOTracker()
+        dog = Watchdog(slo, min_attainment=0.9, window_s=60.0, hysteresis=2)
+        # alternating bad/good windows never accumulate a streak
+        for i in range(10):
+            t = 100.0 + i * 120.0
+            outcome = "violated" if i % 2 == 0 else "ok"
+            slo.record_outcome(outcome, now=t)
+            assert dog.evaluate(now=t + 1.0) == "READY"
+        assert dog.transitions == 0
+
+    def test_idle_replica_is_healthy(self):
+        slo = SLOTracker()
+        dog = Watchdog(slo, min_attainment=0.9, hysteresis=1, min_requests=5)
+        # below min_requests the attainment check is skipped entirely
+        slo.record_outcome("violated", now=10.0)
+        assert dog.evaluate(now=11.0) == "READY"
+        # an empty window is likewise healthy
+        assert dog.evaluate(now=500.0) == "READY"
+
+    def test_p99_ttft_ceiling(self):
+        slo = SLOTracker()
+        dog = Watchdog(slo, min_attainment=0.0, max_p99_ttft_s=0.2,
+                       window_s=60.0, hysteresis=1)
+        slo.record_ttft(0.5, now=10.0)
+        assert dog.evaluate(now=11.0) == "DEGRADED"
+        assert any("p99_ttft" in reason for reason in dog._last_reasons)
+
+    def test_container_health_reports_degraded(self):
+        container = new_mock_container()
+        slo = SLOTracker(container.metrics)
+        container.watchdog = Watchdog(slo, min_attainment=0.9, hysteresis=1)
+        assert container.health()["status"] == "UP"
+        slo.record_outcome("violated", now=10.0)
+        container.watchdog.evaluate(now=11.0)
+        health = container.health()
+        assert health["status"] == "DEGRADED"
+        assert health["watchdog"]["state"] == "DEGRADED"
+        assert health["watchdog"]["transitions"] == 1
+
+    def test_new_watchdog_config(self):
+        container = new_mock_container({"SLO_WATCHDOG_ENABLED": "false"})
+        assert new_watchdog(container.config, SLOTracker()) is None
+        container = new_mock_container({
+            "SLO_MIN_ATTAINMENT": "0.75",
+            "SLO_MAX_P99_TTFT_MS": "250",
+            "SLO_WATCHDOG_HYSTERESIS": "5",
+        })
+        dog = new_watchdog(container.config, SLOTracker())
+        assert dog is not None
+        assert dog.min_attainment == 0.75
+        assert dog.max_p99_ttft_s == pytest.approx(0.25)
+        assert dog.hysteresis == 5
+
+
+# -- acceptance: slow engine + 50ms deadline ---------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _slo_app(tiny_model, deadline_checked=True):
+    cfg, params = tiny_model
+    app = make_app()
+    engine = GenerationEngine(cfg, params, max_slots=2, max_len=64,
+                              prompt_buckets=(8,), logger=app.logger,
+                              metrics=app.container.metrics,
+                              tracer=app.container.tracer,
+                              slo=app.container.slo)
+    app.container.tpu = engine
+    app.enable_varz()
+
+    async def generate(ctx):
+        await engine.start()
+        data = ctx.bind()
+        out = await engine.generate(
+            data["prompt"], max_new_tokens=int(data.get("max_new_tokens", 4)))
+        return {"tokens": out}
+
+    app.post("/generate", generate)
+    return app, engine
+
+
+def test_deadline_violation_goodput_and_varz(tiny_model):
+    """The ISSUE acceptance path: a 50ms deadline against a fresh engine
+    (trace + compile alone exceed it) completes late → outcome=violated,
+    goodput-tokens/s < raw tokens/s, and /debug/varz serves the windowed
+    p99 TTFT."""
+
+    async def main():
+        app, engine = _slo_app(tiny_model)
+        metrics = app.container.metrics
+        async with serving(app) as port:
+            resp = await asyncio.wait_for(http_request(
+                port, "POST", "/generate",
+                body=json.dumps({"prompt": [1, 2, 3],
+                                 "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Deadline-Ms": "50"}), 120.0)
+            assert resp.status == 201
+            assert len(resp.json()["data"]["tokens"]) == 4
+
+            assert metrics.value("app_tpu_slo_total",
+                                 outcome="violated") == 1.0
+            assert metrics.value("app_tpu_slo_total", outcome="ok") is None
+            slo = app.container.slo
+            assert slo.tokens.total() == 4.0
+            assert slo.goodput_tokens.total() == 0.0    # late ≠ goodput
+            assert (slo.goodput_tokens.rate(60.0)
+                    < slo.tokens.rate(60.0))
+
+            varz = (await http_request(
+                port, "GET", "/debug/varz")).json()["data"]
+            assert varz["slo"]["ttft_s"]["60s"]["p99"] is not None
+            assert varz["slo"]["ttft_s"]["60s"]["p99"] > 0.05
+            assert varz["slo"]["60s"]["outcomes"]["violated"] == 1.0
+            assert varz["slo"]["60s"]["slo_attainment"] == 0.0
+            assert "engine" in varz
+            # export_gauges ran during the varz build
+            assert metrics.value("app_tpu_tokens_per_s") > 0.0
+            assert metrics.value("app_tpu_goodput_tokens_per_s") == 0.0
+            await engine.stop()
+    run(main())
+
+
+def test_expired_request_is_shed_with_503(tiny_model):
+    """A deadline that passed before admission never reaches prefill: the
+    engine sheds it (outcome=expired) and HTTP maps DeadlineExceeded's
+    status_code to 503."""
+
+    async def main():
+        app, engine = _slo_app(tiny_model)
+        metrics = app.container.metrics
+        async with serving(app) as port:
+            # warm the engine without any deadline (classified ok)
+            resp = await asyncio.wait_for(http_request(
+                port, "POST", "/generate",
+                body=json.dumps({"prompt": [1, 2],
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"}), 120.0)
+            assert resp.status == 201
+            assert metrics.value("app_tpu_slo_total", outcome="ok") == 1.0
+            assert app.container.slo.goodput_tokens.total() == 2.0
+
+            # 0.0001ms budget: expired before the engine loop can admit it
+            resp = await asyncio.wait_for(http_request(
+                port, "POST", "/generate",
+                body=json.dumps({"prompt": [1, 2, 3],
+                                 "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Deadline-Ms": "0.0001"}), 120.0)
+            assert resp.status == 503
+            assert "deadline" in resp.json()["error"]["message"].lower()
+            assert metrics.value("app_tpu_slo_total",
+                                 outcome="expired") == 1.0
+            await engine.stop()
+    run(main())
+
+
+def test_malformed_deadline_header_is_ignored(tiny_model):
+    async def main():
+        app, engine = _slo_app(tiny_model)
+        async with serving(app) as port:
+            resp = await asyncio.wait_for(http_request(
+                port, "POST", "/generate",
+                body=json.dumps({"prompt": [1, 2],
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Deadline-Ms": "not-a-number"}), 120.0)
+            assert resp.status == 201
+            assert app.container.metrics.value(
+                "app_tpu_slo_total", outcome="ok") == 1.0
+            await engine.stop()
+    run(main())
+
+
+# -- batcher shedding (ctx.predict path) -------------------------------------
+
+def test_batcher_sheds_expired_and_classifies_live():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.tpu import DynamicBatcher, Executor
+
+    container = new_mock_container()
+    executor = Executor(container.logger, container.metrics)
+    executor.register("double", lambda p, x: x * 2.0, params={},
+                      buckets=(1, 2, 4))
+    slo = SLOTracker(container.metrics)
+    batcher = DynamicBatcher(executor, max_delay_ms=1.0,
+                             logger=container.logger, slo=slo)
+
+    async def main():
+        # expired before flush: 100ns of budget cannot survive the
+        # 1ms batching linger
+        set_request_deadline(0.0001)
+        with pytest.raises(DeadlineExceeded):
+            await batcher.predict("double", np.ones((3,), np.float32))
+        set_request_deadline(None)
+        out = await batcher.predict("double", np.ones((3,), np.float32))
+        np.testing.assert_allclose(np.asarray(out), 2.0 * np.ones((3,)))
+
+    asyncio.run(main())
+    assert container.metrics.value("app_tpu_slo_total",
+                                   outcome="expired") == 1.0
+    assert container.metrics.value("app_tpu_slo_total", outcome="ok") == 1.0
+
+
+# -- executor saturation telemetry -------------------------------------------
+
+def test_executor_saturation_duty_cycle_and_mfu():
+    import numpy as np
+
+    from gofr_tpu.tpu import Executor
+
+    container = new_mock_container()
+    executor = Executor(container.logger, container.metrics,
+                        peak_flops=1e12)
+    executor.register("double", lambda p, x: x * 2.0, params={}, buckets=(2,))
+    executor.predict("double", np.ones((2, 4), np.float32))
+    sat = executor.saturation(window_s=60.0)
+    assert sat["window_s"] == 60.0
+    assert sat["busy_s"] > 0.0
+    assert 0.0 < sat["duty_cycle"] <= 1.0
+    assert sat["peak_flops"] == 1e12
+    # mfu is present when peak_flops is configured (may be 0.0 when the
+    # backend's cost_analysis reports no flops for this trivial op)
+    assert sat["mfu"] is not None
+    # hbm stats depend on backend support (CPU may not expose them), but
+    # present entries always carry the full shape
+    assert isinstance(sat["hbm"], dict)
+    for stats in sat["hbm"].values():
+        assert set(stats) >= {"bytes_in_use", "bytes_limit", "occupancy"}
+    assert container.metrics.value("app_tpu_duty_cycle") > 0.0
+
+
+def test_executor_saturation_without_peak_flops():
+    import numpy as np
+
+    from gofr_tpu.tpu import Executor
+
+    container = new_mock_container()
+    executor = Executor(container.logger, container.metrics)
+    executor.register("double", lambda p, x: x * 2.0, params={}, buckets=(2,))
+    executor.predict("double", np.ones((2, 4), np.float32))
+    sat = executor.saturation()
+    assert sat["mfu"] is None        # unconfigured ceiling → no ratio
+    assert sat["peak_flops"] is None
+
+
+# -- metric-name lint --------------------------------------------------------
+
+def test_lint_metrics_passes_on_tree():
+    result = subprocess.run(
+        [sys.executable, "scripts/lint_metrics.py"],
+        capture_output=True, text=True, timeout=120)
+    assert result.returncode == 0, result.stderr
+    assert "OK" in result.stdout
