@@ -75,6 +75,7 @@ def build_app():
     app.container.tpu = engine  # surfaces engine health under /.well-known
     app.enable_statusz()        # live queue/slot/KV-cache/timeline snapshot
     app.enable_varz()           # windowed SLO/goodput/saturation numbers
+    app.enable_xlaz()           # compile ledger + prompt-bucket fit view
 
     @app.on_startup
     async def warm_engine():
@@ -82,6 +83,18 @@ def build_app():
         # the first request: a cold compile is seconds of request latency
         await engine.warmup(prompt_counts=(1, engine.max_slots))
         await engine.start()
+
+    @app.on_shutdown
+    async def log_suggested_ladder():
+        # close the bucket-tuning loop (docs/tpu/model-serving.md): the
+        # padding-optimal prompt ladder for the traffic this process saw,
+        # ready to paste into the next deploy's prompt_buckets
+        fit = engine.xlaz()["models"]["prompt"]
+        if fit["suggested_ladder"]:
+            app.logger.info(
+                "prompt-bucket fit at shutdown: configured=%s observed=%s "
+                "suggested=%s", fit["ladder"],
+                fit["observed_batch_sizes"], fit["suggested_ladder"])
 
     from gofr_tpu.http.errors import HTTPError
     from gofr_tpu.tpu.generate import Sampling

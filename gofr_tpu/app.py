@@ -82,6 +82,7 @@ class App:
         self._grpc_server = None
         self._tasks: List[asyncio.Task] = []
         self._startup_hooks: List[Callable] = []
+        self._shutdown_hooks: List[Callable] = []
         self._shutdown: Optional[asyncio.Event] = None  # created in start()
         self._install_default_middleware()
 
@@ -90,6 +91,15 @@ class App:
         before servers accept traffic — e.g. model warmup so the first
         request never pays a TPU compile. Returns ``func`` (decorator use)."""
         self._startup_hooks.append(func)
+        return func
+
+    def on_shutdown(self, func: Callable) -> Callable:
+        """Register a (possibly async) callable to run first thing inside
+        ``stop()``, while datasources are still open — e.g. logging the
+        ``/debug/xlaz`` suggested bucket ladder so a run's observed traffic
+        shape survives the process. Hook failures are logged, never raised
+        (shutdown must finish). Returns ``func`` (decorator use)."""
+        self._shutdown_hooks.append(func)
         return func
 
     # -- middleware chain (httpServer.go:24-30 order) -----------------------
@@ -225,6 +235,11 @@ class App:
     def enable_varz(self, prefix: str = "/debug/varz") -> None:
         from gofr_tpu.varz import enable_varz
         enable_varz(self, prefix)
+
+    # -- compile/shape-plane xlaz (no reference analog; xlaz.py) ------------
+    def enable_xlaz(self, prefix: str = "/debug/xlaz") -> None:
+        from gofr_tpu.xlaz import enable_xlaz
+        enable_xlaz(self, prefix)
 
     # -- external DB injection (externalDB.go:5-39) -------------------------
     def add_mongo(self, client=None) -> None:
@@ -379,14 +394,16 @@ class App:
                 max_batch=self.config.get_int("TPU_MAX_BATCH", 32),
                 max_delay_ms=self.config.get_float("TPU_BATCH_DELAY_MS", 2.0),
                 logger=self.logger, tracer=self.container.tracer,
-                slo=self.container.slo)
+                slo=self.container.slo, metrics=self.container.metrics)
 
         # degradation watchdog over the SLO rolling windows (slo.py);
-        # SLO_WATCHDOG_ENABLED=false opts out entirely
+        # SLO_WATCHDOG_ENABLED=false opts out entirely. The executor's
+        # compile ledger (when present) feeds its recompile-storm signal.
         from gofr_tpu.slo import new_watchdog
         self.container.watchdog = new_watchdog(
             self.config, self.container.slo, metrics=self.container.metrics,
-            logger=self.logger)
+            logger=self.logger,
+            ledger=getattr(self.container.tpu, "ledger", None))
         if self.container.watchdog is not None:
             self.container.watchdog.start()
 
@@ -417,6 +434,13 @@ class App:
                          f" grpc=:{self.grpc_port}" if self._grpc_server else "")
 
     async def stop(self) -> None:
+        for hook in self._shutdown_hooks:
+            try:
+                result = hook()
+                if asyncio.iscoroutine(result):
+                    await result
+            except Exception as exc:
+                self.logger.error("shutdown hook failed: %r", exc)
         self.crontab.stop()
         if self.container.watchdog is not None:
             await self.container.watchdog.stop()

@@ -167,6 +167,36 @@ class Container:
         metrics.new_counter(
             "app_health_transitions_total",
             "watchdog READY<->DEGRADED flips, labeled by target state")
+        # compile-plane & shape catalog (ISSUE 3): recompiles, padding
+        # waste, bucket fit, flush causes, step-phase anatomy
+        metrics.new_counter(
+            "app_tpu_compile_total",
+            "XLA compiles by cause (warmup|serving) and model — any "
+            "cause=serving increment is a cold compile on the hot path")
+        metrics.new_histogram(
+            "app_tpu_compile_seconds", "one XLA lower+compile wall time (s)",
+            (0.1, 0.3, 1, 3, 10, 30, 100, 300))
+        metrics.new_gauge(
+            "app_tpu_padding_ratio",
+            "fraction of executed device rows that were padding, over the "
+            "rolling window")
+        metrics.new_gauge(
+            "app_tpu_effective_mfu",
+            "MFU counting only real (non-padding) rows' FLOPs")
+        metrics.new_counter(
+            "app_tpu_bucket_hits_total",
+            "executes per (model, bucket) — the observed bucket ladder fit")
+        metrics.new_counter(
+            "app_tpu_flush_total",
+            "dynamic-batcher flushes by cause (full|timer) and model")
+        metrics.new_histogram(
+            "app_tpu_batch_fill",
+            "flushed batch size / max_batch per flush",
+            (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+        metrics.new_histogram(
+            "app_tpu_step_phase_seconds",
+            "device-step phase split: host_prep | enqueue | device_wait",
+            (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3))
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",

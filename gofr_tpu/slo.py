@@ -11,9 +11,11 @@ tokens/s). This module owns that accounting:
   monotonic instant and stashes it in a contextvar, which survives into
   async handlers and ``asyncio.to_thread`` — the batcher and generation
   engine read it at submit time without any signature churn in user code.
-- Each completion is classified ``ok | violated | expired``:
+- Each completion is classified ``ok | violated | expired | error``:
   ``ok`` finished within deadline (or had none), ``violated`` finished
-  but late, ``expired`` was shed before prefill because its deadline had
+  but late, ``error`` failed outright inside the serving stack (counted
+  so errored traffic doesn't silently inflate attainment),
+  ``expired`` was shed before prefill because its deadline had
   already passed — spending HBM and flops on it could only produce a
   response the client stopped waiting for (the drop-expired idiom from
   the batch-size/latency tradeoff literature, arxiv 1812.11731).
@@ -40,6 +42,13 @@ from gofr_tpu.metrics.digest import WindowedCounter, WindowedDigest
 OUTCOME_OK = "ok"
 OUTCOME_VIOLATED = "violated"
 OUTCOME_EXPIRED = "expired"
+# the request failed outright (device step raised) — it never produced a
+# deadline-classifiable completion, but dropping it from the accounting
+# would overstate attainment exactly when the replica is sickest
+OUTCOME_ERROR = "error"
+
+TERMINAL_OUTCOMES = (OUTCOME_OK, OUTCOME_VIOLATED, OUTCOME_EXPIRED,
+                     OUTCOME_ERROR)
 
 
 class DeadlineExceeded(Exception):
@@ -102,9 +111,8 @@ class SLOTracker:
         self.tokens = WindowedCounter(slice_s, max_window_s)
         self.goodput_tokens = WindowedCounter(slice_s, max_window_s)
         self.outcomes: Dict[str, WindowedCounter] = {
-            OUTCOME_OK: WindowedCounter(slice_s, max_window_s),
-            OUTCOME_VIOLATED: WindowedCounter(slice_s, max_window_s),
-            OUTCOME_EXPIRED: WindowedCounter(slice_s, max_window_s),
+            name: WindowedCounter(slice_s, max_window_s)
+            for name in TERMINAL_OUTCOMES
         }
 
     # -- event feeds --------------------------------------------------------
@@ -143,8 +151,8 @@ class SLOTracker:
         None when the window is empty (no data is not bad data)."""
         now = time.monotonic() if now is None else now
         ok = self.outcomes[OUTCOME_OK].sum(window_s, now)
-        bad = (self.outcomes[OUTCOME_VIOLATED].sum(window_s, now)
-               + self.outcomes[OUTCOME_EXPIRED].sum(window_s, now))
+        bad = sum(self.outcomes[name].sum(window_s, now)
+                  for name in TERMINAL_OUTCOMES if name != OUTCOME_OK)
         total = ok + bad
         if total <= 0:
             return None
@@ -181,7 +189,7 @@ class SLOTracker:
                                    if attainment is not None else None),
                 "outcomes": {
                     name: self.outcomes[name].sum(window, now)
-                    for name in (OUTCOME_OK, OUTCOME_VIOLATED, OUTCOME_EXPIRED)
+                    for name in TERMINAL_OUTCOMES
                 },
             }
         out["lifetime"] = {
@@ -209,12 +217,22 @@ class Watchdog:
                  logger: Any = None, *, min_attainment: float = 0.9,
                  max_p99_ttft_s: Optional[float] = None,
                  window_s: float = 60.0, interval_s: float = 5.0,
-                 hysteresis: int = 3, min_requests: int = 1):
+                 hysteresis: int = 3, min_requests: int = 1,
+                 ledger: Any = None,
+                 max_serving_compiles: Optional[int] = None):
         self.slo = slo
         self.metrics = metrics
         self.logger = logger
         self.min_attainment = min_attainment
         self.max_p99_ttft_s = max_p99_ttft_s
+        # recompile-storm signal (ISSUE 3): a CompileLedger (or anything
+        # duck-typing serving_compiles(window_s, now)) plus a per-window
+        # ceiling on serve-time compiles. Each one stalls every request
+        # for its model behind the compile lock, so a burst degrades the
+        # replica as surely as an attainment collapse — and shows up here
+        # minutes before the latency windows catch up.
+        self.ledger = ledger
+        self.max_serving_compiles = max_serving_compiles
         self.window_s = window_s
         self.interval_s = interval_s
         self.hysteresis = max(1, int(hysteresis))
@@ -231,8 +249,7 @@ class Watchdog:
         now = time.monotonic() if now is None else now
         reasons = []
         terminal = sum(self.slo.outcomes[name].sum(self.window_s, now)
-                       for name in (OUTCOME_OK, OUTCOME_VIOLATED,
-                                    OUTCOME_EXPIRED))
+                       for name in TERMINAL_OUTCOMES)
         if terminal >= max(self.min_requests, 1):
             attainment = self.slo.attainment(self.window_s, now)
             if attainment is not None and attainment < self.min_attainment:
@@ -242,6 +259,14 @@ class Watchdog:
             p99 = self.slo.ttft.quantile(0.99, self.window_s, now)
             if p99 is not None and p99 > self.max_p99_ttft_s:
                 reasons.append(f"p99_ttft {p99:.3f}s > {self.max_p99_ttft_s}s")
+        # recompile storm: independent of min_requests — the compiles
+        # themselves prove the replica is doing (the wrong kind of) work
+        if self.ledger is not None and self.max_serving_compiles is not None:
+            compiles = self.ledger.serving_compiles(self.window_s, now)
+            if compiles > self.max_serving_compiles:
+                reasons.append(
+                    f"recompile storm: {compiles:.0f} serve-time compiles "
+                    f"in {self.window_s:.0f}s > {self.max_serving_compiles}")
         self._last_reasons = reasons
         if reasons:
             self._bad_streak += 1
@@ -306,6 +331,7 @@ class Watchdog:
             "thresholds": {
                 "min_attainment": self.min_attainment,
                 "max_p99_ttft_s": self.max_p99_ttft_s,
+                "max_serving_compiles": self.max_serving_compiles,
                 "window_s": self.window_s,
                 "hysteresis": self.hysteresis,
                 "min_requests": self.min_requests,
@@ -314,13 +340,17 @@ class Watchdog:
 
 
 def new_watchdog(config: Any, slo: SLOTracker, metrics: Any = None,
-                 logger: Any = None) -> Optional[Watchdog]:
+                 logger: Any = None, ledger: Any = None) -> Optional[Watchdog]:
     """Config-driven factory. Returns None when disabled
     (``SLO_WATCHDOG_ENABLED=false``). ``SLO_MAX_P99_TTFT_MS`` unset means
-    the TTFT ceiling check is off; attainment defaults to 0.9."""
+    the TTFT ceiling check is off; attainment defaults to 0.9. With a
+    compile ledger wired, ``SLO_MAX_SERVING_COMPILES`` (default 3, 0
+    disables) bounds serve-time compiles per window before the replica
+    reports a recompile storm."""
     if not config.get_bool("SLO_WATCHDOG_ENABLED", True):
         return None
     max_ttft_ms = config.get_float("SLO_MAX_P99_TTFT_MS", 0.0)
+    max_compiles = int(config.get_float("SLO_MAX_SERVING_COMPILES", 3))
     return Watchdog(
         slo, metrics=metrics, logger=logger,
         min_attainment=config.get_float("SLO_MIN_ATTAINMENT", 0.9),
@@ -329,4 +359,6 @@ def new_watchdog(config: Any, slo: SLOTracker, metrics: Any = None,
         interval_s=config.get_float("SLO_WATCHDOG_INTERVAL_S", 5.0),
         hysteresis=int(config.get_float("SLO_WATCHDOG_HYSTERESIS", 3)),
         min_requests=int(config.get_float("SLO_WATCHDOG_MIN_REQUESTS", 1)),
+        ledger=ledger,
+        max_serving_compiles=max_compiles if max_compiles > 0 else None,
     )

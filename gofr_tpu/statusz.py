@@ -63,6 +63,17 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
                 status["saturation"] = saturation_fn()
             except Exception as exc:
                 status["saturation"] = {"error": repr(exc)}
+        # compile-plane summary (ISSUE 3): totals + the serve-time-compile
+        # window the watchdog acts on; the full table lives on /debug/xlaz
+        ledger = getattr(tpu, "ledger", None)
+        if ledger is not None:
+            compiles = ledger.snapshot(limit=8)
+            status["compiles"] = {
+                "total": compiles["total"],
+                "by_cause": compiles["by_cause"],
+                "serving_compiles_60s": compiles["serving_compiles_60s"],
+                "recent": compiles["recent"],
+            }
 
     return status
 

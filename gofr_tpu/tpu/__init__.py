@@ -4,10 +4,15 @@ health (north star, BASELINE.json). The identical executor runs on the
 CPU backend in tests — the "miniredis of XLA" strategy (SURVEY.md §4)."""
 
 from gofr_tpu.tpu.batcher import DynamicBatcher
+from gofr_tpu.tpu.compile_ledger import (CAUSE_SERVING, CAUSE_WARMUP,
+                                         CompileLedger, ShapeStats,
+                                         fingerprint_lowered, suggest_ladder)
 from gofr_tpu.tpu.executor import DEFAULT_BUCKETS, Executor, new_executor
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.tpu.generate import GenerationEngine
 
 __all__ = ["DynamicBatcher", "Executor", "FlightRecorder",
            "GenerationEngine", "RequestRecord", "new_executor",
-           "DEFAULT_BUCKETS"]
+           "DEFAULT_BUCKETS", "CompileLedger", "ShapeStats",
+           "CAUSE_WARMUP", "CAUSE_SERVING", "fingerprint_lowered",
+           "suggest_ladder"]

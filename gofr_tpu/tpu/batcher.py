@@ -49,14 +49,19 @@ class _Pending:
 class DynamicBatcher:
     def __init__(self, executor, max_batch: int = 32,
                  max_delay_ms: float = 2.0, logger=None, tracer=None,
-                 slo=None):
+                 slo=None, metrics=None):
         self.executor = executor
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1000.0
         self.logger = logger
         self.tracer = tracer
         self.slo = slo  # SLOTracker (goodput/outcome accounting), optional
+        self.metrics = metrics
         self._pending: Dict[str, _Pending] = {}
+        # flush-cause accounting (ISSUE 3): "full" flushes mean the ladder/
+        # max_batch is the binding constraint, "timer" flushes mean traffic
+        # is — the ratio tells you which knob to turn
+        self.flush_causes: Dict[str, int] = {"full": 0, "timer": 0}
 
     async def predict(self, name: str, example: Any) -> Any:
         """Submit ONE example (no batch axis); returns its result slice."""
@@ -76,7 +81,7 @@ class DynamicBatcher:
         # flush time, after queue wait has eaten part of the budget
         pending.deadlines.append(current_deadline())
         if len(pending.examples) >= self.max_batch:
-            self._flush(name)
+            self._flush(name, cause="full")
         elif pending.timer is None:
             pending.timer = loop.call_later(self.max_delay,
                                             self._flush, name)
@@ -88,13 +93,22 @@ class DynamicBatcher:
         return {name: len(p.examples)
                 for name, p in self._pending.items() if p.examples}
 
-    def _flush(self, name: str) -> None:
+    def _flush(self, name: str, cause: str = "timer") -> None:
         pending = self._pending.get(name)
         if pending is None or not pending.examples:
             return
         if pending.timer is not None:
             pending.timer.cancel()
         self._pending[name] = _Pending()
+        self.flush_causes[cause] = self.flush_causes.get(cause, 0) + 1
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_tpu_flush_total",
+                                           cause=cause, model=name)
+            # fill ratio vs max_batch: persistently low fill on "timer"
+            # flushes means the linger window, not capacity, bounds batches
+            self.metrics.record_histogram(
+                "app_tpu_batch_fill",
+                len(pending.examples) / max(self.max_batch, 1), model=name)
         for span in pending.spans:
             if span is not None:
                 span.set_attribute("batch_size", len(pending.examples))
@@ -184,6 +198,10 @@ class DynamicBatcher:
             for future in futures:
                 if not future.done():
                     future.set_exception(exc)
+                # errored traffic must not silently vanish from goodput
+                # math: classify every request the failed step carried
+                if self.slo is not None:
+                    self.slo.record_outcome("error")
 
 
 class _null_ctx:

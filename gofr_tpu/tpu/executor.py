@@ -23,6 +23,7 @@ wrap driver libs with config/logging/metrics/health (e.g.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
@@ -30,6 +31,14 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from gofr_tpu.metrics.digest import WindowedCounter
+from gofr_tpu.tpu.compile_ledger import (
+    CAUSE_SERVING,
+    CAUSE_WARMUP,
+    CompileLedger,
+    ShapeStats,
+    fingerprint_lowered,
+    suggest_ladder,
+)
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
@@ -61,7 +70,9 @@ class Executor:
     """
 
     def __init__(self, logger, metrics, mesh=None, batch_axis: str = "dp",
-                 donate_cache: bool = False, peak_flops: float = 0.0):
+                 donate_cache: bool = False, peak_flops: float = 0.0,
+                 ledger: Optional[CompileLedger] = None,
+                 recorder: Any = None):
         import jax
         self._jax = jax
         self.logger = logger
@@ -77,9 +88,24 @@ class Executor:
         self.peak_flops = float(peak_flops)
         self._busy_s = WindowedCounter()
         self._flops_done = WindowedCounter()
+        # padded-FLOPs split: _flops_useful counts only the real rows'
+        # share of each execute, so MFU can report raw vs *effective*
+        self._flops_useful = WindowedCounter()
         # cost_analysis FLOPs per (model, bucket); None = analysis
         # unavailable on this backend, don't retry every step
         self._flops_cache: Dict[Tuple[str, int], Optional[float]] = {}
+        # compile-plane & shape-plane observability (ISSUE 3): every
+        # compile — warmup or serving — lands in the ledger; every
+        # execute lands in the shape stats (real rows vs bucket)
+        self.ledger = ledger if ledger is not None \
+            else CompileLedger(metrics)
+        self.shapes = ShapeStats(metrics)
+        # flight recorder for step-phase timelines (statusz); optional
+        self.recorder = recorder
+        # (model, bucket) -> monotonic start of an in-progress serve-time
+        # compile — surfaced by health_check so an operator can see what
+        # the model lock is stuck behind
+        self._compiling: Dict[Tuple[str, int], float] = {}
 
     # -- registration (analog of datasource connect) ------------------------
     def register(self, name: str, fn: Callable, params: Any,
@@ -117,7 +143,7 @@ class Executor:
         for bucket in model.buckets:
             batch = self._tree_unflatten(
                 example, [np.repeat(l[None], bucket, axis=0) for l in leaves])
-            self._execute(model, batch, bucket)
+            self._execute(model, batch, bucket, cause=CAUSE_WARMUP)
 
     # -- predict (the hot path) ---------------------------------------------
     def predict(self, name: str, inputs: Any) -> Any:
@@ -178,27 +204,46 @@ class Executor:
         # no context — can stamp the latency histogram's exemplar
         from gofr_tpu.trace import current_span
         span = current_span()
+        # step-phase anatomy: host_prep = host-side padding/stacking,
+        # enqueue = building device args + queueing the (async) execute —
+        # a serve-time compile shows up as a pathological enqueue phase —
+        # device_wait = the block_until_ready in fetch
         padded = self._tree_unflatten(
             inputs, [_pad_batch(np.asarray(l), bucket) for l in leaves])
+        prepped = time.perf_counter()
         out = self._execute_async(model, padded, bucket)
-        return (name, out, n, start, span, bucket)
+        enqueued = time.perf_counter()
+        phases = {"host_prep": prepped - start, "enqueue": enqueued - prepped}
+        return (name, out, n, start, span, bucket, phases)
 
     def fetch(self, handle) -> Any:
         """Sync a ``dispatch`` handle: wait for the execute, record metrics,
         slice off the padding."""
-        name, out, n, start, span, bucket = handle
+        name, out, n, start, span, bucket, phases = handle
+        wait_start = time.perf_counter()
         out = self._jax.block_until_ready(out)
-        elapsed = time.perf_counter() - start
+        done = time.perf_counter()
+        phases = dict(phases, device_wait=done - wait_start)
+        elapsed = done - start
         exemplar = ({"trace_id": span.trace_id} if span is not None else None)
         self.metrics.record_histogram("app_tpu_execute", elapsed,
                                       exemplar=exemplar, model=name)
         self.metrics.record_histogram("app_tpu_batch_size", float(n),
                                       model=name)
         self.metrics.increment_counter("app_tpu_requests_total", model=name)
+        for phase, seconds in phases.items():
+            self.metrics.record_histogram("app_tpu_step_phase_seconds",
+                                          seconds, phase=phase, model=name)
+        self.shapes.record(name, n, bucket)
+        if self.recorder is not None:
+            self.recorder.record_step(model=name, bucket=bucket, batch=n,
+                                      phases=phases)
         self._busy_s.add(elapsed)
         flops = self._bucket_flops(name, bucket)
         if flops:
             self._flops_done.add(flops)
+            # only the real rows' share of the padded execute is useful
+            self._flops_useful.add(flops * n / bucket)
         return self._jax.tree.map(lambda l: np.asarray(l)[:n], out)
 
     # -- saturation telemetry ------------------------------------------------
@@ -242,7 +287,13 @@ class Executor:
         busy = self._busy_s.sum(window_s)
         duty = busy / max(window_s, 1e-9)
         flops_per_s = self._flops_done.rate(window_s)
+        useful_per_s = self._flops_useful.rate(window_s)
         mfu = (flops_per_s / self.peak_flops) if self.peak_flops > 0 else None
+        # effective MFU discounts padded rows: raw MFU can look healthy
+        # while half the device rows are zeros
+        effective_mfu = (useful_per_s / self.peak_flops
+                         if self.peak_flops > 0 else None)
+        padding_ratio = self.shapes.padding_ratio(window_s)
         hbm: Dict[str, Any] = {}
         for device in self.devices:
             try:
@@ -261,24 +312,35 @@ class Executor:
             "busy_s": round(busy, 4),
             "duty_cycle": round(duty, 4),
             "flops_per_s": flops_per_s,
+            "useful_flops_per_s": useful_per_s,
             "mfu": round(mfu, 4) if mfu is not None else None,
+            "effective_mfu": (round(effective_mfu, 4)
+                              if effective_mfu is not None else None),
+            "padding_ratio": (round(padding_ratio, 4)
+                              if padding_ratio is not None else None),
             "peak_flops": self.peak_flops or None,
             "hbm": hbm,
         }
         self.metrics.set_gauge("app_tpu_duty_cycle", min(duty, 1.0))
         if mfu is not None:
             self.metrics.set_gauge("app_tpu_mfu", mfu)
+        if effective_mfu is not None:
+            self.metrics.set_gauge("app_tpu_effective_mfu", effective_mfu)
+        if padding_ratio is not None:
+            self.metrics.set_gauge("app_tpu_padding_ratio", padding_ratio)
         for device_id, entry in hbm.items():
             if entry["occupancy"] is not None:
                 self.metrics.set_gauge("app_tpu_hbm_occupancy",
                                        entry["occupancy"], device=device_id)
         return out
 
-    def _execute(self, model: _Model, padded: Any, bucket: int) -> Any:
+    def _execute(self, model: _Model, padded: Any, bucket: int,
+                 cause: str = CAUSE_SERVING) -> Any:
         return self._jax.block_until_ready(
-            self._execute_async(model, padded, bucket))
+            self._execute_async(model, padded, bucket, cause=cause))
 
-    def _execute_async(self, model: _Model, padded: Any, bucket: int) -> Any:
+    def _execute_async(self, model: _Model, padded: Any, bucket: int,
+                       cause: str = CAUSE_SERVING) -> Any:
         """Enqueue H2D + execute; returns un-synced device arrays (JAX async
         dispatch)."""
         compiled = model.compiled.get(bucket)
@@ -286,15 +348,83 @@ class Executor:
             with model.lock:
                 compiled = model.compiled.get(bucket)
                 if compiled is None:
-                    t0 = time.perf_counter()
-                    args = self._constrain(padded)
-                    compiled = model.fn.lower(model.params,
-                                              args).compile()
-                    model.compiled[bucket] = compiled
-                    self.logger.info(
-                        "tpu: compiled %s bucket=%d in %.1fs", model.name,
-                        bucket, time.perf_counter() - t0)
-        return compiled(model.params, self._constrain(padded))
+                    compiled = self._compile(model, padded, bucket, cause)
+        # serving labels on the device timeline: an on-demand XProf
+        # capture shows which model/bucket each execute belongs to
+        with self._trace_annotation(f"{model.name}/b{bucket}"):
+            return compiled(model.params, self._constrain(padded))
+
+    def _compile(self, model: _Model, padded: Any, bucket: int,
+                 cause: str):
+        """One ``.lower().compile()`` under ``model.lock``: records the
+        ledger event (with HLO fingerprint) and — for serve-time compiles,
+        which stall every request for this model behind the lock — logs at
+        warn with the queue impact instead of a quiet info line."""
+        key = (model.name, bucket)
+        if cause == CAUSE_SERVING and self.logger is not None:
+            self.logger.warn(
+                "tpu: serve-time compile of %s bucket=%d started — "
+                "requests for this model queue behind model.lock until it "
+                "finishes (warm this bucket at startup to avoid it)",
+                model.name, bucket)
+        self._compiling[key] = time.monotonic()
+        try:
+            t0 = time.perf_counter()
+            args = self._constrain(padded)
+            lowered = model.fn.lower(model.params, args)
+            compiled = lowered.compile()
+            duration = time.perf_counter() - t0
+        finally:
+            self._compiling.pop(key, None)
+        model.compiled[bucket] = compiled
+        event = self.ledger.record(model.name, bucket, cause, duration,
+                                   fingerprint_lowered(lowered))
+        if self.logger is not None:
+            log = (self.logger.warn if cause == CAUSE_SERVING
+                   else self.logger.info)
+            log("tpu: compiled %s bucket=%d in %.1fs (cause=%s, "
+                "fingerprint=%s)", model.name, bucket, duration, cause,
+                event.fingerprint)
+        return compiled
+
+    def _trace_annotation(self, label: str):
+        """``jax.profiler.TraceAnnotation`` context for the given label, or
+        a no-op where the profiler API is unavailable — annotation must
+        never be the thing that breaks an execute."""
+        try:
+            return self._jax.profiler.TraceAnnotation(label)
+        except Exception:
+            return contextlib.nullcontext()
+
+    # -- compile/shape-plane snapshot (/debug/xlaz) --------------------------
+    def xlaz(self, recent: int = 64, max_rungs: int = 4) -> Dict[str, Any]:
+        """The bucket-tuning view: compile ledger, observed batch-size
+        distribution vs the registered ladder per model, padding-waste
+        windows, and a padding-optimal suggested ladder derived from the
+        observed distribution (rounded to the dp-mesh multiple when a
+        mesh is present)."""
+        round_to = 1
+        if self.mesh is not None and self.batch_axis in self.mesh.shape:
+            round_to = self.mesh.shape[self.batch_axis]
+        models: Dict[str, Any] = {}
+        for name, model in self._models.items():
+            observed = self.shapes.distribution(name)
+            models[name] = {
+                "ladder": list(model.buckets),
+                "buckets_compiled": sorted(model.compiled),
+                "observed_batch_sizes": {str(k): v for k, v
+                                         in sorted(observed.items())},
+                "bucket_hits": {str(k): v for k, v in
+                                sorted(self.shapes.bucket_hits(name).items())},
+                "suggested_ladder": suggest_ladder(
+                    observed, max_rungs=max(len(model.buckets), max_rungs),
+                    round_to=round_to),
+            }
+        return {
+            "compiles": self.ledger.snapshot(limit=recent),
+            "models": models,
+            "padding": self.shapes.snapshot(),
+        }
 
     def _constrain(self, inputs: Any):
         jax = self._jax
@@ -346,6 +476,12 @@ class Executor:
         details["models"] = {
             name: {"buckets_compiled": sorted(m.compiled)}
             for name, m in self._models.items()}
+        # serve-time compiles in flight: these hold model.lock, so every
+        # request for that model is invisibly queued behind them (ISSUE 3)
+        now = time.monotonic()
+        details["compiling"] = [
+            {"model": name, "bucket": bucket, "for_s": round(now - since, 3)}
+            for (name, bucket), since in list(self._compiling.items())]
         details["status"] = "UP" if all_up else "DOWN"
         return details
 

@@ -126,12 +126,17 @@ class FlightRecorder:
     snapshots from the admin endpoint, and batcher fetches from worker
     threads."""
 
-    def __init__(self, capacity: int = 256):
+    def __init__(self, capacity: int = 256, step_capacity: int = 128):
         self.capacity = capacity
         self._lock = threading.Lock()
         self._inflight: Dict[int, RequestRecord] = {}
         self._completed: "deque[RequestRecord]" = deque(maxlen=capacity)
+        # step-phase anatomy ring (ISSUE 3): one entry per device step
+        # with its host_prep/enqueue/device_wait split — the per-step twin
+        # of the per-request timeline above
+        self._steps: "deque[Dict[str, Any]]" = deque(maxlen=step_capacity)
         self._total = 0
+        self._total_steps = 0
 
     def start(self, record: RequestRecord) -> RequestRecord:
         with self._lock:
@@ -145,13 +150,36 @@ class FlightRecorder:
             if self._inflight.pop(id(record), None) is not None:
                 self._completed.append(record)
 
+    def record_step(self, model: str, bucket: int, batch: int,
+                    phases: Dict[str, float]) -> None:
+        """One executed device step with its phase split (seconds). Called
+        by the executor's fetch — possibly on a worker thread."""
+        entry = {
+            "at": time.time(),
+            "model": model,
+            "bucket": bucket,
+            "batch": batch,
+            "fill": round(batch / bucket, 4) if bucket else None,
+            "phases": {name: round(seconds, 6)
+                       for name, seconds in phases.items()},
+        }
+        with self._lock:
+            self._total_steps += 1
+            self._steps.append(entry)
+
     def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
         with self._lock:
             inflight = [r.to_dict() for r in self._inflight.values()]
             recent = [r.to_dict() for r in self._completed]
+            steps = list(self._steps)
+            total_steps = self._total_steps
         if limit is not None:
             recent = recent[-limit:]
+            steps = steps[-limit:]
         recent.reverse()   # newest first — the ops-facing order
+        steps.reverse()
         return {"total_requests": self._total,
                 "in_flight": inflight,
-                "recent": recent}
+                "recent": recent,
+                "total_steps": total_steps,
+                "steps": steps}

@@ -57,6 +57,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from gofr_tpu.slo import DeadlineExceeded, current_deadline
+from gofr_tpu.tpu.compile_ledger import ShapeStats, suggest_ladder
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.trace import Span, current_span
 
@@ -258,6 +259,10 @@ class GenerationEngine:
             self._n_ladder.append(max_slots)
         self.logger = logger
         self.metrics = metrics
+        # prompt-bucket fit accounting (ISSUE 3): the engine's static
+        # shapes are prompt-length buckets, so its padding waste is
+        # prompt tokens, not batch rows — same ShapeStats machinery
+        self.shapes = ShapeStats(metrics)
         self.tracer = tracer   # None → span emission off, recorder still on
         self.recorder: FlightRecorder = recorder or FlightRecorder()
         self.slo = slo         # SLOTracker: goodput/outcome accounting
@@ -592,6 +597,7 @@ class GenerationEngine:
                 f"{self.prompt_buckets[-1]}")
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens exceeds cache length")
+        self.shapes.record("prompt", len(prompt), bucket)
         return prompt, bucket
 
     def _new_flight(self, prompt: List[int], budget: int) -> _Flight:
@@ -721,6 +727,32 @@ class GenerationEngine:
             },
             "stats": self.stats(),
             "requests": self.recorder.snapshot(limit=recent),
+        }
+
+    def xlaz(self, recent: int = 64, max_rungs: int = 4) -> Dict[str, Any]:
+        """Compile-plane view for ``/debug/xlaz``. The engine compiles
+        lazily through ``jax.jit`` caches rather than an explicit
+        ``.lower().compile()`` ledger, so the actionable signal here is
+        shape fit: the observed prompt-length distribution against the
+        configured prompt buckets, and the padding-optimal ladder those
+        lengths would prefer. Same schema as ``Executor.xlaz`` so the
+        endpoint renders either."""
+        observed = self.shapes.distribution("prompt")
+        return {
+            "models": {
+                "prompt": {
+                    "ladder": list(self.prompt_buckets),
+                    "observed_batch_sizes": {
+                        str(k): v for k, v in sorted(observed.items())},
+                    "bucket_hits": {
+                        str(k): v for k, v in
+                        sorted(self.shapes.bucket_hits("prompt").items())},
+                    "suggested_ladder": suggest_ladder(
+                        observed,
+                        max_rungs=max(len(self.prompt_buckets), max_rungs)),
+                },
+            },
+            "padding": self.shapes.snapshot(),
         }
 
     def health_check(self) -> Dict[str, Any]:

@@ -12,15 +12,22 @@ enforces:
    intentionally-unprefixed process runtime gauges in ``ALLOW_UNPREFIXED``;
 3. every observed name is registered somewhere in the tree, so a typo'd
    observation (silently dropped at runtime by Manager's error-log-and-
-   continue policy) fails CI instead of producing a hole in a dashboard.
+   continue policy) fails CI instead of producing a hole in a dashboard;
+4. every registered ``app_``-prefixed name appears in the metrics catalog
+   in ``docs/quick-start/observability.md`` — the docs-drift gate: adding
+   a metric without documenting it (or renaming one and orphaning its
+   catalog row) fails CI. ``--docs PATH`` points the check at an
+   alternate catalog file (used by the lint's own negative test).
 
 Exit code 0 = clean, 1 = violations (one per line on stderr).
-Run directly or via scripts/tier1.sh; tests/test_slo_observability.py also
-invokes it so the lint itself stays under test.
+Run directly or via scripts/tier1.sh; tests/test_slo_observability.py and
+tests/test_compile_observability.py also invoke it so the lint itself
+stays under test.
 """
 
 from __future__ import annotations
 
+import argparse
 import ast
 import pathlib
 import re
@@ -28,8 +35,12 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 PACKAGE = ROOT / "gofr_tpu"
+DOCS_CATALOG = ROOT / "docs" / "quick-start" / "observability.md"
 
 NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# any app_-namespaced token in the docs counts as "documented" — rows in
+# the catalog table, prose mentions, and code samples all qualify
+DOC_NAME_RE = re.compile(r"\bapp_[a-zA-Z0-9_]+\b")
 
 # process-runtime gauges predating the app_ namespace convention; kept
 # unprefixed for parity with common node-exporter dashboards
@@ -74,7 +85,14 @@ def _metric_calls(tree: ast.AST):
             yield method, first.value, node.lineno
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--docs", type=pathlib.Path, default=DOCS_CATALOG,
+        help="metrics catalog to check app_ names against "
+             "(default: docs/quick-start/observability.md)")
+    opts = parser.parse_args(argv)
+
     registered = set()
     observed = []  # (path, lineno, name)
     problems = []
@@ -106,6 +124,23 @@ def main() -> int:
             problems.append(
                 f"{rel}:{lineno}: metric {name!r} is observed but never "
                 f"registered — Manager drops it at runtime")
+
+    # docs-drift gate: every registered app_ metric must be documented
+    try:
+        documented = set(
+            DOC_NAME_RE.findall(opts.docs.read_text(encoding="utf-8")))
+    except OSError as exc:
+        problems.append(f"{opts.docs}: unreadable metrics catalog: {exc}")
+        documented = None
+    if documented is not None:
+        docs_rel = (opts.docs.relative_to(ROOT)
+                    if opts.docs.is_relative_to(ROOT) else opts.docs)
+        for name in sorted(registered):
+            if name.startswith("app_") and name not in documented:
+                problems.append(
+                    f"{docs_rel}: metric {name!r} is registered in source "
+                    f"but missing from the metrics catalog — document it "
+                    f"(or remove the registration)")
 
     for problem in problems:
         print(problem, file=sys.stderr)

@@ -6,8 +6,9 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
-# static metric-name lint (app_ prefix + OpenMetrics charset) runs before
-# the test sweep so a bad metric name fails fast
+# static metric-name lint (app_ prefix + OpenMetrics charset + docs-drift
+# check against the observability.md catalog) runs before the test sweep
+# so a bad or undocumented metric name fails fast
 python scripts/lint_metrics.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

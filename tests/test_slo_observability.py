@@ -170,7 +170,7 @@ class TestSLOTracker:
         assert snap["60s"]["tokens_per_s"] == pytest.approx(10.0)
         assert snap["60s"]["goodput_tokens_per_s"] == pytest.approx(5.0)
         assert snap["60s"]["outcomes"] == {"ok": 1.0, "violated": 1.0,
-                                           "expired": 0.0}
+                                           "expired": 0.0, "error": 0.0}
         assert snap["lifetime"]["tokens_total"] == 600.0
 
 
