@@ -64,6 +64,13 @@ def build_app():
         # decode ticks in flight before the oldest fetch must land: token
         # fetches overlap device compute and each other (D2H pipelining)
         max_inflight_ticks=int(os.environ.get("INFLIGHT_TICKS", "4")),
+        # prefix KV reuse: shared prompt prefixes (system prompts, few-shot
+        # templates) prefill only their suffix against cached KV pages.
+        # Greedy outputs stay token-identical with bf16 caches
+        # (docs/tpu/model-serving.md "Prefix KV reuse")
+        prefix_cache=os.environ.get("GENERATE_PREFIX_CACHE") == "1",
+        prefix_cache_bytes=int(os.environ.get(
+            "GENERATE_PREFIX_CACHE_BYTES", str(64 << 20))),
         logger=app.logger, metrics=app.container.metrics,
         # flight recorder: queue.wait/prefill/decode child spans per
         # request, engine-step spans with links, /debug/statusz timelines

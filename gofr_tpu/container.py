@@ -197,6 +197,17 @@ class Container:
             "app_tpu_step_phase_seconds",
             "device-step phase split: host_prep | enqueue | device_wait",
             (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3))
+        # prefix-KV reuse catalog (ISSUE 4): radix-cache hit rates and the
+        # prompt tokens whose prefill FLOPs the cache avoided
+        metrics.new_counter(
+            "app_tpu_prefix_lookup_total",
+            "prefix-cache lookups by result (hit|partial|miss)")
+        metrics.new_updown_counter(
+            "app_tpu_prefix_tokens_saved_total",
+            "prompt tokens served from cached prefix KV instead of prefill")
+        metrics.new_gauge(
+            "app_tpu_prefix_cache_occupancy",
+            "prefix-KV page pool: used pages / total pages")
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",

@@ -334,10 +334,21 @@ class _PartitionFetcher(threading.Thread):
     def _stopping(self) -> bool:
         return self.stop_event.is_set() or self.client._closed
 
+    def _sleep(self, seconds: float) -> None:
+        """Interruptible sleep: long connection backoffs must still honor
+        stop() promptly (_stop_fetchers joins with a 5 s timeout)."""
+        deadline = time.monotonic() + seconds
+        while not self._stopping():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            time.sleep(min(0.1, remaining))
+
     def run(self) -> None:
         client = self.client
         conn: Optional[_Broker] = None
         offset_failures = 0
+        conn_backoff = 0.5
         try:
             while not self._stopping():
                 started = time.monotonic()
@@ -394,16 +405,21 @@ class _PartitionFetcher(threading.Thread):
                     # sibling fetcher for one partition's outage. The
                     # metadata refresh is equally non-fatal: bootstrap
                     # being down too (whole-cluster restart) just means
-                    # retry next pass.
+                    # retry next pass. Backoff doubles toward 10 s so a
+                    # long outage isn't a half-second reconnect hammer,
+                    # and the refresh is throttled topic-wide (every
+                    # sibling fetcher hits this path at once).
                     if conn is not None:
                         conn.close()
                         conn = None
                     try:
-                        client._refresh_metadata(self.topic)
+                        client._refresh_metadata_throttled(self.topic)
                     except (OSError, ConnectionError, KafkaError):
                         pass
-                    time.sleep(0.5)
+                    self._sleep(conn_backoff)
+                    conn_backoff = min(conn_backoff * 2, 10.0)
                     continue
+                conn_backoff = 0.5   # successful fetch: connection healthy
                 for offset, key, value in batch:
                     self.offset = offset + 1
                     # unwrap the opt-in trace envelope (base.py): the
@@ -471,6 +487,7 @@ class KafkaClient(PubSub):
         self._brokers: Dict[Tuple[str, int], _Broker] = {}
         self._meta_lock = threading.Lock()
         self._leaders: Dict[Tuple[str, int], Tuple[str, int]] = {}
+        self._meta_refreshed_at: Dict[str, float] = {}
         self._queues: Dict[str, "queue.Queue[Optional[Message]]"] = {}
         self._pollers: Dict[str, threading.Thread] = {}
         self._closed = False
@@ -523,7 +540,27 @@ class KafkaClient(PubSub):
                     if leader in nodes:
                         with self._meta_lock:
                             self._leaders[(topic, partition)] = nodes[leader]
+        with self._meta_lock:
+            self._meta_refreshed_at[topic] = time.monotonic()
         return sorted(partitions)
+
+    def _refresh_metadata_throttled(self, topic: str,
+                                    min_interval: float = 1.0) -> None:
+        """Topic-wide refresh rate limit. When a broker dies, every one of
+        the topic's partition fetchers hits its reconnect path at once and
+        each would issue an identical Metadata request per backoff tick —
+        a refresh stampede against the (possibly still recovering)
+        bootstrap broker. Only one fetcher per interval refreshes; the
+        rest reuse its result from the shared leader cache."""
+        with self._meta_lock:
+            last = self._meta_refreshed_at.get(topic)
+            if last is not None \
+                    and time.monotonic() - last < min_interval:
+                return
+            # claim the interval before the slow lock-free refresh so
+            # racing fetchers skip instead of queueing up behind it
+            self._meta_refreshed_at[topic] = time.monotonic()
+        self._refresh_metadata(topic)
 
     def _leader_addr(self, topic: str, partition: int) -> Tuple[str, int]:
         addr = self._leaders.get((topic, partition))

@@ -33,6 +33,7 @@ from gofr_tpu.ops import (
     decode_attention,
     decode_attention_cached,
     prefill_attention,
+    prefix_prefill_attention,
     rms_norm,
     rope_table,
 )
@@ -219,7 +220,9 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
 
 def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
             cache: Dict[str, jnp.ndarray],
-            lengths: Optional[jnp.ndarray] = None
+            lengths: Optional[jnp.ndarray] = None,
+            prefix: Optional[Dict[str, jnp.ndarray]] = None,
+            prefix_len: int = 0
             ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
     """Run the prompt, fill the cache. Returns (last-token logits (B, V),
     cache, cache_len (B,)).
@@ -228,55 +231,84 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,
     path): logits are taken at position lengths-1 per sequence and
     cache_len = lengths, so junk positions past a prompt's real end are
     never attended to in decode.
+
+    ``prefix``/``prefix_len`` is the suffix-only prefill path (prefix KV
+    reuse, tpu/prefix_cache): ``prefix`` holds pre-computed KV for the
+    prompt's first ``prefix_len`` tokens (same leaves as ``cache``,
+    shapes (L, B, prefix_len, ...)), ``tokens`` carries only the suffix.
+    RoPE positions offset by the *static* ``prefix_len`` and attention
+    for each suffix token spans cached-prefix + suffix
+    (ops.prefix_prefill_attention); the returned ``cache`` still holds
+    only the suffix KV (the caller owns prefix placement) while
+    ``cache_len`` counts prefix + suffix. With ``cfg.kv_int8`` the prefix
+    arrives quantized and is dequantized to the compute dtype here —
+    decode reads quantized KV either way, but suffix-prefill logits see
+    quantization-level drift vs a full prefill (documented contract:
+    exact token-identity holds for bf16 caches).
     """
     b, s = tokens.shape
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
-    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    positions = jnp.broadcast_to(
+        prefix_len + jnp.arange(s, dtype=jnp.int32), (b, s))
     x = params["tok_emb"][tokens]
-    if cfg.use_flash:
+    if cfg.use_flash and prefix is None:
+        # the flash kernel is strictly causal — the prefix path needs the
+        # rectangular prefix block, so it uses the dense mask form
         from gofr_tpu.ops.pallas import flash_attention as attend
     else:
         attend = prefill_attention
 
-    def body(x, layer_and_cache):
-        layer = layer_and_cache[0]
+    xs: Dict[str, Any] = {"layer": params["layers"], "cache": cache}
+    if prefix is not None:
+        xs["prefix"] = prefix
+
+    def body(x, xs):
+        layer = xs["layer"]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
-        attn = attend(q, k, v).reshape(b, s, -1)
+        if prefix is None:
+            attn = attend(q, k, v).reshape(b, s, -1)
+        else:
+            pk, pv = xs["prefix"]["k"], xs["prefix"]["v"]
+            if cfg.kv_int8:
+                pk = pk.astype(cfg.dtype) * \
+                    xs["prefix"]["ks"][..., None].astype(cfg.dtype)
+                pv = pv.astype(cfg.dtype) * \
+                    xs["prefix"]["vs"][..., None].astype(cfg.dtype)
+            k_all = jnp.concatenate([pk, k], axis=1)
+            v_all = jnp.concatenate([pv, v], axis=1)
+            attn = prefix_prefill_attention(
+                q, k_all, v_all, prefix_len).reshape(b, s, -1)
         x = x + qmm(attn, layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
         x = x + _ffn(layer, h)
         if cfg.kv_int8:
-            _, k_cache, v_cache, ks_cache, vs_cache = layer_and_cache
             kq, ks = quantize_kv(k)
             vq, vs = quantize_kv(v)
-            k_cache = lax.dynamic_update_slice_in_dim(k_cache, kq, 0, axis=1)
-            v_cache = lax.dynamic_update_slice_in_dim(v_cache, vq, 0, axis=1)
-            ks_cache = lax.dynamic_update_slice_in_dim(ks_cache, ks, 0,
-                                                       axis=1)
-            vs_cache = lax.dynamic_update_slice_in_dim(vs_cache, vs, 0,
-                                                       axis=1)
-            return x, (k_cache, v_cache, ks_cache, vs_cache)
-        _, k_cache, v_cache = layer_and_cache
-        k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, 0, axis=1)
-        v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, 0, axis=1)
-        return x, (k_cache, v_cache)
+            new_cache = {
+                "k": lax.dynamic_update_slice_in_dim(
+                    xs["cache"]["k"], kq, 0, axis=1),
+                "v": lax.dynamic_update_slice_in_dim(
+                    xs["cache"]["v"], vq, 0, axis=1),
+                "ks": lax.dynamic_update_slice_in_dim(
+                    xs["cache"]["ks"], ks, 0, axis=1),
+                "vs": lax.dynamic_update_slice_in_dim(
+                    xs["cache"]["vs"], vs, 0, axis=1)}
+        else:
+            new_cache = {
+                "k": lax.dynamic_update_slice_in_dim(
+                    xs["cache"]["k"], k, 0, axis=1),
+                "v": lax.dynamic_update_slice_in_dim(
+                    xs["cache"]["v"], v, 0, axis=1)}
+        return x, new_cache
 
-    if cfg.kv_int8:
-        x, (k_new, v_new, ks_new, vs_new) = lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"],
-                      cache["ks"], cache["vs"]))
-        new_cache = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new}
-    else:
-        x, (k_new, v_new) = lax.scan(body, x, (params["layers"],
-                                               cache["k"], cache["v"]))
-        new_cache = {"k": k_new, "v": v_new}
+    x, new_cache = lax.scan(body, x, xs)
     if lengths is None:
         last = x[:, -1]
-        cache_len = jnp.full((b,), s, jnp.int32)
+        cache_len = jnp.full((b,), prefix_len + s, jnp.int32)
     else:
         last = x[jnp.arange(b), lengths - 1]
-        cache_len = lengths.astype(jnp.int32)
+        cache_len = prefix_len + lengths.astype(jnp.int32)
     last = rms_norm(last, params["out_norm"], cfg.norm_eps)
     logits = qmm(last, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache, cache_len

@@ -11,11 +11,13 @@ from gofr_tpu.ops.attention import (
     decode_attention,
     decode_attention_cached,
     prefill_attention,
+    prefix_prefill_attention,
 )
 from gofr_tpu.ops.norms import layer_norm, rms_norm
 from gofr_tpu.ops.rotary import apply_rope, rope_table
 
 __all__ = [
     "attention", "causal_mask", "decode_attention", "prefill_attention",
+    "prefix_prefill_attention",
     "layer_norm", "rms_norm", "apply_rope", "rope_table",
 ]

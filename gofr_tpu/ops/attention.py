@@ -53,6 +53,24 @@ def prefill_attention(q, k, v) -> jnp.ndarray:
     return attention(q, k, v, mask)
 
 
+def prefix_prefill_attention(q, k, v, prefix_len: int) -> jnp.ndarray:
+    """Causal attention for a suffix prefill over cached-prefix + suffix
+    K/V (the prefix-KV-reuse path, tpu/prefix_cache).
+
+    q: (B, S, Hq, D) — the S suffix tokens, at absolute positions
+    ``prefix_len + i``; k, v: (B, prefix_len + S, Hkv, D) — the cached
+    prefix K/V concatenated with the suffix's fresh K/V, in absolute
+    position order. ``prefix_len`` is static. Every query may attend the
+    whole prefix plus causally into the suffix, i.e. key position
+    ``j <= prefix_len + i``.
+    """
+    s_len = q.shape[1]
+    t_len = k.shape[1]
+    mask = (jnp.arange(t_len)[None, :]
+            <= prefix_len + jnp.arange(s_len)[:, None])
+    return attention(q, k, v, mask[None, None, None])
+
+
 def decode_attention(q, k_cache, v_cache, cache_len) -> jnp.ndarray:
     """One-token decode against a static-shape KV cache.
 

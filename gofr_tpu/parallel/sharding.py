@@ -74,6 +74,19 @@ def llama_cache_specs(dp: str = "dp", tp: str = "tp",
     return specs
 
 
+def llama_prefix_pool_specs(tp: str = "tp",
+                            kv_int8: bool = False) -> Dict[str, P]:
+    """Prefix-KV page pool (L, num_pages, page, Hkv, Dh): kv-heads on tp
+    like the main cache; pages replicate across dp (any dp shard may
+    gather any page — tpu/prefix_cache)."""
+    spec = P(None, None, None, tp, None)
+    specs = {"k": spec, "v": spec}
+    if kv_int8:
+        specs["ks"] = P(None, None, None, tp)
+        specs["vs"] = P(None, None, None, tp)
+    return specs
+
+
 def moe_param_specs(tp: str = "tp", ep: str = "ep") -> Dict[str, Any]:
     """PartitionSpecs for gofr_tpu.models.moe: expert-stacked FFN weights
     (L, E, D, F) shard the expert axis on ``ep`` (GSPMD lowers the

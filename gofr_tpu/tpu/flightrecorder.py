@@ -32,7 +32,8 @@ class RequestRecord:
     __slots__ = ("trace_id", "span_id", "model", "prompt_len", "budget",
                  "wall_enqueued_at", "enqueued_at", "admitted_at",
                  "first_token_at", "finished_at", "tokens", "status",
-                 "ticks", "batch_min", "batch_max", "batch_sum")
+                 "ticks", "batch_min", "batch_max", "batch_sum",
+                 "cached_prefix_len")
 
     def __init__(self, model: str = "generate", prompt_len: int = 0,
                  budget: int = 0, trace_id: Optional[str] = None,
@@ -53,6 +54,7 @@ class RequestRecord:
         self.batch_min = 0
         self.batch_max = 0
         self.batch_sum = 0
+        self.cached_prefix_len = 0   # prompt tokens served from prefix KV
 
     # -- event hooks (engine/batcher call these) ---------------------------
     def admitted(self) -> None:
@@ -104,6 +106,7 @@ class RequestRecord:
             "model": self.model,
             "status": self.status,
             "prompt_len": self.prompt_len,
+            "cached_prefix_len": self.cached_prefix_len,
             "budget": self.budget,
             "enqueued_at": self.wall_enqueued_at,
             "queue_wait_s": _round(self.queue_wait_s),

@@ -208,6 +208,9 @@ class GenerationEngine:
                  max_inflight_ticks: int = 2,
                  mesh=None,
                  window_ladder: bool = True,
+                 prefix_cache: bool = False,
+                 prefix_cache_bytes: int = 64 << 20,
+                 prefix_page: int = 32,
                  logger=None, metrics=None, tracer=None, recorder=None,
                  slo=None):
         import jax
@@ -307,6 +310,35 @@ class GenerationEngine:
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._insert_fns: Dict[Tuple[int, int], Any] = {}
         self._decode_fns: Dict[int, Any] = {}
+        # prefix KV reuse (ISSUE 4): page-granular prefix store + the
+        # suffix-only prefill/insert executable families keyed
+        # (nb, prefix_pages, suffix_bucket). The prefix-pages ladder
+        # (1,2,4,... plus the max) bounds the executable set; a cached
+        # prefix rounds DOWN to a rung and the remainder rides the suffix.
+        self._suffix_prefill_fns: Dict[Tuple[int, int, int], Any] = {}
+        self._suffix_insert_fns: Dict[Tuple[int, int, int], Any] = {}
+        self._prefill_bucket_tokens = 0   # bucket rows*cols dispatched to
+        self._prefill_real_tokens = 0     # prefill vs real prompt tokens
+        self._prefix = None
+        self._p_ladder: List[int] = []
+        if prefix_cache and self.prompt_buckets:
+            from gofr_tpu.tpu.prefix_cache import PrefixStore
+            max_pages = max(self.prompt_buckets) // prefix_page
+            if max_pages > 0:
+                self._p_ladder = [1]
+                while self._p_ladder[-1] * 2 <= max_pages:
+                    self._p_ladder.append(self._p_ladder[-1] * 2)
+                if self._p_ladder[-1] != max_pages:
+                    self._p_ladder.append(max_pages)
+                self._prefix = PrefixStore(
+                    cfg, page=prefix_page,
+                    budget_bytes=prefix_cache_bytes,
+                    max_pages=max_pages, mesh=mesh, metrics=metrics)
+            elif logger is not None:
+                logger.warn(
+                    "prefix cache disabled: page size %d exceeds the "
+                    "largest prompt bucket %d", prefix_page,
+                    max(self.prompt_buckets))
 
     # -- compiled steps -----------------------------------------------------
     def _prefill_fn(self, nb: int, lb: int):
@@ -366,6 +398,80 @@ class GenerationEngine:
 
             fn = jax.jit(insert, donate_argnums=(0, 5, 6, 7, 8, 9, 10))
             self._insert_fns[(nb, lb)] = fn
+        return fn
+
+    def _suffix_prefill_fn(self, nb: int, p: int, lb: int):
+        """Suffix-only prompt forward (prefix KV reuse): gathers ``p``
+        cached pages per row from the prefix pool and runs the llama
+        prefill over only the suffix bucket ``lb``, with RoPE positions
+        offset by the static prefix length. Same contract as
+        ``_prefill_fn`` otherwise — (first_tokens, suffix small cache,
+        advanced keys). The pool is read, never written."""
+        fn = self._suffix_prefill_fns.get((nb, p, lb))
+        if fn is None:
+            jax, llama, cfg = self._jax, self._llama, self.cfg
+            from gofr_tpu.ops.sampling import sample_batch
+            plen = p * self._prefix.page
+
+            def suffix_prefill(params, pool, page_ids, tokens, lengths,
+                               temps, top_ks, top_ps, seeds):
+                # (L, N, page, ...) pages -> (L, nb, plen, ...) prefix KV
+                prefix = {
+                    name: pool[name][:, page_ids].reshape(
+                        pool[name].shape[0], nb, plen,
+                        *pool[name].shape[3:])
+                    for name in pool}
+                small = llama.init_cache(cfg, nb, lb)
+                logits, small, _ = llama.prefill(
+                    params, cfg, tokens, small, lengths=lengths,
+                    prefix=prefix, prefix_len=plen)
+                keys = jax.vmap(jax.random.PRNGKey)(seeds)
+                first, keys = sample_batch(logits, temps, top_ks, top_ps,
+                                           keys)
+                return first, small, keys
+
+            fn = jax.jit(suffix_prefill)
+            self._suffix_prefill_fns[(nb, p, lb)] = fn
+        return fn
+
+    def _suffix_insert_fn(self, nb: int, p: int, lb: int):
+        """Widened insert scatter for the suffix path: writes the ``p``
+        prefix pages into cache rows [0, plen) AND the fresh suffix KV
+        into [plen, plen+lb) for each claimed slot, in one executable.
+        cache_len becomes prefix + suffix length. The pool argument is
+        never donated (in-flight suffix prefills may still read it)."""
+        fn = self._suffix_insert_fns.get((nb, p, lb))
+        if fn is None:
+            jax = self._jax
+            plen = p * self._prefix.page
+
+            def insert(cache, pool, page_ids, small, slots, lengths, first,
+                       cache_len, last_token, temps, top_ks, top_ps,
+                       sample_keys, new_t, new_k, new_p, new_keys):
+                pref = {
+                    name: pool[name][:, page_ids].reshape(
+                        pool[name].shape[0], nb, plen,
+                        *pool[name].shape[3:])
+                    for name in pool}
+                cache = {name: cache[name]
+                         .at[:, slots, :plen].set(pref[name], mode="drop")
+                         .at[:, slots, plen:plen + lb].set(
+                             small[name], mode="drop")
+                         for name in cache}
+                cache_len = cache_len.at[slots].set(plen + lengths,
+                                                    mode="drop")
+                last_token = last_token.at[slots].set(first, mode="drop")
+                temps = temps.at[slots].set(new_t, mode="drop")
+                top_ks = top_ks.at[slots].set(new_k, mode="drop")
+                top_ps = top_ps.at[slots].set(new_p, mode="drop")
+                sample_keys = sample_keys.at[slots].set(new_keys,
+                                                        mode="drop")
+                return (cache, cache_len, last_token, temps,
+                        top_ks, top_ps, sample_keys)
+
+            fn = jax.jit(insert,
+                         donate_argnums=(0, 7, 8, 9, 10, 11, 12))
+            self._suffix_insert_fns[(nb, p, lb)] = fn
         return fn
 
     def _decode_fn(self, k_steps: int, sampled: bool = False,
@@ -517,14 +623,19 @@ class GenerationEngine:
                     f"is 'all' (full-matrix warmup)")
             window_rungs = list(self._window_ladder)
         else:
-            unknown = [w for w in windows if w not in self._window_ladder]
-            if unknown or not windows:
+            # stats()["window_ladder"] spells the top rung as max_len, so
+            # accept max_len as an alias for the internal None sentinel —
+            # callers can pass the ladder exactly as stats() printed it
+            requested = [None if w == self.max_len else w for w in windows]
+            unknown = [w for w in requested if w not in self._window_ladder]
+            if unknown or not requested:
                 raise ValueError(
-                    f"warmup windows={unknown or windows} are not "
-                    f"window-ladder rungs {self._window_ladder}; nothing "
+                    f"warmup windows={unknown or list(windows)} are not "
+                    f"window-ladder rungs {self._window_ladder} (max_len="
+                    f"{self.max_len} aliases the None top rung); nothing "
                     f"would be warmed for them and the first serving tick "
                     f"would compile on the hot path")
-            window_rungs = [w for w in self._window_ladder if w in windows]
+            window_rungs = [w for w in self._window_ladder if w in requested]
         if self.logger is not None:
             n = len(rungs) * len(window_rungs) * (2 if sampling else 1)
             self.logger.info(
@@ -686,15 +797,25 @@ class GenerationEngine:
         return sum(1 for slot in self._slots if slot.active)
 
     def stats(self) -> Dict[str, Any]:
-        return {"active_slots": self.active_slots,
-                "free_slots": len(self._free),
-                "queue_depth": self._pending.qsize(),
-                "decode_steps": self._steps,
-                "prefill_batches": self._prefills,
-                "max_len": self.max_len,
-                "window_ladder": [w or self.max_len
-                                  for w in self._window_ladder],
-                "mesh": dict(self.mesh.shape) if self.mesh else None}
+        out = {"active_slots": self.active_slots,
+               "free_slots": len(self._free),
+               "queue_depth": self._pending.qsize(),
+               "decode_steps": self._steps,
+               "prefill_batches": self._prefills,
+               # prompt-FLOPs proxy: bucket tokens actually dispatched to
+               # prefill executables vs the real (non-padding, non-reused)
+               # prompt tokens inside them — prefix reuse shrinks the
+               # former for the same admitted traffic
+               "prefill_bucket_tokens": self._prefill_bucket_tokens,
+               "prefill_real_tokens": self._prefill_real_tokens,
+               "max_len": self.max_len,
+               "window_ladder": [w or self.max_len
+                                 for w in self._window_ladder],
+               "mesh": dict(self.mesh.shape) if self.mesh else None}
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
+            out["prefix_cache"]["page_ladder"] = list(self._p_ladder)
+        return out
 
     def statusz(self, recent: int = 32) -> Dict[str, Any]:
         """Live JSON snapshot for ``/debug/statusz``: admission queue depth,
@@ -738,7 +859,7 @@ class GenerationEngine:
         lengths would prefer. Same schema as ``Executor.xlaz`` so the
         endpoint renders either."""
         observed = self.shapes.distribution("prompt")
-        return {
+        out = {
             "models": {
                 "prompt": {
                     "ladder": list(self.prompt_buckets),
@@ -754,6 +875,19 @@ class GenerationEngine:
             },
             "padding": self.shapes.snapshot(),
         }
+        if self._prefix is not None:
+            # prefix reuse multiplies the prefill-executable family by the
+            # page ladder — surface both the ladder and the realized
+            # hit/save rates so an operator can judge whether the extra
+            # compiles pay for themselves
+            out["prefix_cache"] = {
+                "page_ladder": list(self._p_ladder),
+                "page_tokens": self._prefix.page,
+                "store": self._prefix.stats(),
+                "prefill_bucket_tokens": self._prefill_bucket_tokens,
+                "prefill_real_tokens": self._prefill_real_tokens,
+            }
+        return out
 
     def health_check(self) -> Dict[str, Any]:
         """Container-health contract (container/health.go analog)."""
@@ -834,6 +968,11 @@ class GenerationEngine:
         self.top_ps = jnp.ones((self.max_slots,), jnp.float32)
         self.sample_keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
         self._mask_key = None
+        # the prefix store's pages may be poisoned too (a failed publish
+        # consumed nothing, but the index must not advertise pages whose
+        # pool handle is being rebuilt) — drop the whole store
+        if self._prefix is not None:
+            self._prefix.reset()
 
     def _fail_outstanding(self, exc: BaseException) -> None:
         """Propagate a loop failure to every caller bound to an active slot
@@ -906,9 +1045,46 @@ class GenerationEngine:
         if entry.span is not None:   # step span covers dispatch → publish
             entry.span.finish()
 
+    def _prefix_plan(self, prompt: List[int], bucket: int):
+        """Plan prefix reuse for one request: look up the longest cached
+        page chain, round DOWN to a prefix-pages ladder rung (the
+        remainder rides the suffix), and pick the suffix bucket. Returns
+        (p_rung, suffix_bucket, page_ids, pinned_nodes) — p_rung 0 means
+        full prefill. Pins the used nodes; the caller releases them at
+        the end of the admission pass."""
+        store = self._prefix
+        chain = store.lookup(prompt)
+        store.classify(len(chain), store.max_lookup_pages(len(prompt)))
+        p = 0
+        for rung in self._p_ladder:
+            if rung <= len(chain):
+                p = rung
+        sb = bucket
+        while p:
+            plen = p * store.page
+            suffix_len = len(prompt) - plen
+            fit = next((b for b in self.prompt_buckets
+                        if b >= suffix_len
+                        and plen + b <= self.max_len), None)
+            if fit is not None:
+                sb = fit
+                break
+            # widened insert would overrun max_len: drop a rung
+            smaller = [r for r in self._p_ladder if r < p]
+            p = smaller[-1] if smaller else 0
+        if p == 0:
+            return 0, bucket, [], []
+        nodes = chain[:p]
+        store.acquire(nodes)
+        store.record_saved(p * store.page)
+        return p, sb, [n.page_id for n in nodes], nodes
+
     async def _admit_pending(self, loop):
         """Drain the queue into slots; one batched prefill dispatch per
-        prompt-length bucket. Returns [(first_dev, [(slot, gen, row)])]
+        (prefix-pages, prompt-length-bucket) group — prefix_pages is 0
+        (full prefill, publishing its pages back to the prefix store when
+        one is configured) or a prefix-ladder rung (suffix-only prefill
+        gathering cached pages). Returns [(first_dev, [(slot, gen, row)])]
         fetch handles for the first generated tokens."""
         requests: List[Tuple] = []
         while self._free[len(requests):] and not self._pending.empty():
@@ -918,7 +1094,8 @@ class GenerationEngine:
         jnp = self._jnp
         fetches: List[Tuple[Any, List[Tuple[int, int, int]],
                             Optional[Span]]] = []
-        by_bucket: Dict[int, List[Tuple]] = {}
+        by_group: Dict[Tuple[int, int], List[Tuple]] = {}
+        leases: List[Any] = []
         for prompt, bucket, budget, eos_id, sampling, future, queue, \
                 submitted_at, flight in requests:
             if queue is not None and queue in self._cancelled_queues:
@@ -952,20 +1129,26 @@ class GenerationEngine:
                         "(%.1fms past deadline)",
                         (time.monotonic() - flight.deadline) * 1000.0)
                 continue
-            by_bucket.setdefault(bucket, []).append(
+            p_rung, sb, page_ids, nodes = (
+                self._prefix_plan(prompt, bucket)
+                if self._prefix is not None else (0, bucket, [], []))
+            leases.extend(nodes)
+            by_group.setdefault((p_rung, sb), []).append(
                 (prompt, budget, eos_id, sampling, future, queue,
-                 submitted_at, flight))
+                 submitted_at, flight, page_ids))
         if self._pending.empty():
             # no queued request can match a leftover entry any more —
             # bound the set (cancel-after-completion would otherwise leak)
             self._cancelled_queues.clear()
-        # Phase 1: claim slots for EVERY bucket group before dispatching
-        # any prefill — if one bucket's dispatch raises, every admitted
+        # Phase 1: claim slots for EVERY group before dispatching any
+        # prefill — if one group's dispatch raises, every admitted
         # request is bound to a slot and _fail_outstanding reaches it
-        # (otherwise later buckets' callers would hang unresolved).
-        staged: List[Tuple[int, int, Any, List[Tuple[int, int, int]]]] = []
-        for bucket, group in sorted(by_bucket.items()):
+        # (otherwise later groups' callers would hang unresolved).
+        staged: List[Tuple[int, int, int, bool, Any,
+                           List[Tuple[int, int, int]]]] = []
+        for (p_rung, bucket), group in sorted(by_group.items()):
             nb = next(x for x in self._n_ladder if x >= len(group))
+            plen = p_rung * self._prefix.page if p_rung else 0
             padded = np.zeros((nb, bucket), np.int32)
             lengths = np.ones((nb,), np.int32)
             slots = np.full((nb,), self.max_slots, np.int32)  # OOB → drop
@@ -973,9 +1156,10 @@ class GenerationEngine:
             top_ks = np.zeros((nb,), np.int32)
             top_ps = np.ones((nb,), np.float32)
             seeds = np.zeros((nb,), np.uint32)
+            page_mat = np.zeros((nb, p_rung), np.int32)
             claimed: List[Tuple[int, int, int]] = []          # (slot,gen,row)
             for row, (prompt, budget, eos_id, sampling, future, queue,
-                      submitted_at, flight) in enumerate(group):
+                      submitted_at, flight, page_ids) in enumerate(group):
                 slot_idx = self._free.pop()
                 slot = self._slots[slot_idx]
                 slot.future = future
@@ -996,6 +1180,7 @@ class GenerationEngine:
                     flight.qspan.set_attribute("slot", slot_idx)
                     flight.qspan.finish()
                 flight.record.admitted()
+                flight.record.cached_prefix_len = plen
                 slot.record = flight.record
                 slot.req_span = flight.link_span
                 slot.phase_span = (
@@ -1004,8 +1189,15 @@ class GenerationEngine:
                 if slot.phase_span is not None:
                     slot.phase_span.set_attribute("slot", slot_idx)
                     slot.phase_span.set_attribute("prompt_len", len(prompt))
-                padded[row, :len(prompt)] = prompt
-                lengths[row] = len(prompt)
+                    slot.phase_span.set_attribute("cached_prefix_len", plen)
+                # only the suffix past the reused prefix is prefilled
+                # (the whole prompt when p_rung == 0)
+                suffix = prompt[plen:]
+                padded[row, :len(suffix)] = suffix
+                lengths[row] = len(suffix)
+                self._prefill_real_tokens += len(suffix)
+                if p_rung:
+                    page_mat[row] = page_ids
                 slots[row] = slot_idx
                 temps[row] = max(sampling.temperature, 0.0)
                 top_ks[row] = sampling.top_k
@@ -1013,38 +1205,106 @@ class GenerationEngine:
                 seeds[row] = np.uint32(sampling.seed & 0xFFFFFFFF)
                 claimed.append((slot_idx, slot.gen, row))
 
-            def dispatch(bucket=bucket, nb=nb, padded=padded,
-                         lengths=lengths, slots=slots, temps=temps,
-                         top_ks=top_ks, top_ps=top_ps, seeds=seeds):
-                first, small, keys = self._prefill_fn(nb, bucket)(
-                    self.params, jnp.asarray(padded), jnp.asarray(lengths),
-                    jnp.asarray(temps), jnp.asarray(top_ks),
-                    jnp.asarray(top_ps), jnp.asarray(seeds))
-                (self.cache, self.cache_len, self.last_token, self.temps,
-                 self.top_ks, self.top_ps, self.sample_keys) = \
-                    self._insert_fn(nb, bucket)(
-                        self.cache, small, jnp.asarray(slots),
-                        jnp.asarray(lengths), first,
-                        self.cache_len, self.last_token, self.temps,
-                        self.top_ks, self.top_ps, self.sample_keys,
+            # a full prefill publishes its page-aligned prefix back into
+            # the store (dedup'd: already-cached pages keep the num_pages
+            # sentinel and the scatter drops them)
+            publish_ids = None
+            if p_rung == 0 and self._prefix is not None:
+                store = self._prefix
+                np_max = min(bucket // store.page, store.max_pages)
+                if np_max > 0:
+                    flat = np.full((nb * np_max,), store.num_pages,
+                                   np.int32)
+                    new_any = False
+                    for row, entry in enumerate(group):
+                        want = min(len(entry[0]) // store.page, np_max)
+                        if want <= 0:
+                            continue
+                        pages = store.insert(entry[0], want)
+                        for j, (pid, is_new) in enumerate(pages):
+                            if is_new:
+                                flat[row * np_max + j] = pid
+                                new_any = True
+                    if new_any:
+                        publish_ids = flat
+
+            if p_rung == 0:
+                def dispatch(bucket=bucket, nb=nb, padded=padded,
+                             lengths=lengths, slots=slots, temps=temps,
+                             top_ks=top_ks, top_ps=top_ps, seeds=seeds,
+                             publish_ids=publish_ids):
+                    first, small, keys = self._prefill_fn(nb, bucket)(
+                        self.params, jnp.asarray(padded),
+                        jnp.asarray(lengths),
                         jnp.asarray(temps), jnp.asarray(top_ks),
-                        jnp.asarray(top_ps), keys)
-                return first
+                        jnp.asarray(top_ps), jnp.asarray(seeds))
+                    (self.cache, self.cache_len, self.last_token, self.temps,
+                     self.top_ks, self.top_ps, self.sample_keys) = \
+                        self._insert_fn(nb, bucket)(
+                            self.cache, small, jnp.asarray(slots),
+                            jnp.asarray(lengths), first,
+                            self.cache_len, self.last_token, self.temps,
+                            self.top_ks, self.top_ps, self.sample_keys,
+                            jnp.asarray(temps), jnp.asarray(top_ks),
+                            jnp.asarray(top_ps), keys)
+                    if publish_ids is not None:
+                        # insert does not donate `small`, so the publish
+                        # scatter can read it after the insert dispatch
+                        self._prefix.publish(small, publish_ids, nb, bucket)
+                    return first
 
-            staged.append((nb, bucket, dispatch, claimed))
-
-        # Phase 2: dispatch per bucket (first-time compiles run off-loop;
-        # warm dispatch is ~free)
-        for nb, bucket, dispatch, claimed in staged:
-            step_span = self._step_span("tpu.engine.prefill", claimed,
-                                        bucket=bucket, padded_batch=nb)
-            if (nb, bucket) in self._prefill_fns \
-                    and (nb, bucket) in self._insert_fns:
-                first_dev = dispatch()
+                warm = ((nb, bucket) in self._prefill_fns
+                        and (nb, bucket) in self._insert_fns
+                        and (publish_ids is None
+                             or self._prefix.publish_ready(nb, bucket)))
             else:
-                first_dev = await loop.run_in_executor(None, dispatch)
-            self._prefills += 1
-            fetches.append((first_dev, claimed, step_span))
+                def dispatch(p=p_rung, bucket=bucket, nb=nb, padded=padded,
+                             lengths=lengths, slots=slots, temps=temps,
+                             top_ks=top_ks, top_ps=top_ps, seeds=seeds,
+                             page_mat=page_mat):
+                    first, small, keys = self._suffix_prefill_fn(
+                        nb, p, bucket)(
+                        self.params, self._prefix.pool,
+                        jnp.asarray(page_mat), jnp.asarray(padded),
+                        jnp.asarray(lengths), jnp.asarray(temps),
+                        jnp.asarray(top_ks), jnp.asarray(top_ps),
+                        jnp.asarray(seeds))
+                    (self.cache, self.cache_len, self.last_token, self.temps,
+                     self.top_ks, self.top_ps, self.sample_keys) = \
+                        self._suffix_insert_fn(nb, p, bucket)(
+                            self.cache, self._prefix.pool,
+                            jnp.asarray(page_mat), small,
+                            jnp.asarray(slots), jnp.asarray(lengths), first,
+                            self.cache_len, self.last_token, self.temps,
+                            self.top_ks, self.top_ps, self.sample_keys,
+                            jnp.asarray(temps), jnp.asarray(top_ks),
+                            jnp.asarray(top_ps), keys)
+                    return first
+
+                warm = ((nb, p_rung, bucket) in self._suffix_prefill_fns
+                        and (nb, p_rung, bucket) in self._suffix_insert_fns)
+
+            staged.append((nb, bucket, p_rung, warm, dispatch, claimed))
+
+        # Phase 2: dispatch per group (first-time compiles run off-loop;
+        # warm dispatch is ~free). Leases release after every dispatch:
+        # pinned pages must survive until the suffix gathers that read
+        # them are ordered behind any publish that could recycle a page.
+        try:
+            for nb, bucket, p_rung, warm, dispatch, claimed in staged:
+                step_span = self._step_span("tpu.engine.prefill", claimed,
+                                            bucket=bucket, padded_batch=nb,
+                                            prefix_pages=p_rung)
+                if warm:
+                    first_dev = dispatch()
+                else:
+                    first_dev = await loop.run_in_executor(None, dispatch)
+                self._prefills += 1
+                self._prefill_bucket_tokens += nb * bucket
+                fetches.append((first_dev, claimed, step_span))
+        finally:
+            if self._prefix is not None and leases:
+                self._prefix.release(leases)
         return fetches
 
     def _step_span(self, name: str, participants,

@@ -1,0 +1,337 @@
+"""Device-resident prefix KV cache for the generate engine (ISSUE 4).
+
+Real /generate traffic is dominated by shared prompt prefixes (system
+prompts, few-shot templates); recomputing them on every admission burns
+prefill FLOPs and TTFT on tokens whose KV was produced seconds ago. This
+module keeps that KV: a host-side trie over *page-aligned* prompt token
+ids maps each page (a fixed run of ``page`` tokens) to one row of a
+device-resident page pool, so a later prompt sharing the prefix prefills
+only its suffix (models/llama.prefill ``prefix=``/``prefix_len=``).
+
+Design (Ragged Paged Attention's layout lesson, PAPERS.md — block-granular
+KV is how flexible reuse stays static-shape on TPU):
+
+- **Page pool**: one array per KV-cache leaf, shaped
+  ``(L, num_pages, page, Hkv, Dh)`` (int8 caches add the scale planes
+  ``(L, num_pages, page, Hkv)``), allocated once under an HBM byte budget
+  and sharded like the main cache (kv-heads on ``tp`` —
+  parallel/sharding.llama_prefix_pool_specs). ``num_pages`` doubles as
+  the out-of-bounds sentinel page id for ``mode="drop"`` scatters.
+- **Trie index (host)**: each node is one page keyed by its token tuple;
+  a chain of nodes from the root spells a cached prefix. Pure host
+  bookkeeping — lookups never touch the device.
+- **Refcounting**: the engine pins the nodes it is about to gather from
+  (``acquire``) for the span of one admission pass, so a concurrent
+  publish in the same pass can never evict-and-overwrite a page an
+  in-flight suffix prefill will read.
+- **LRU eviction**: when the pool is full, the least-recently-used
+  *leaf* node (no children, refcount 0) is evicted — interior nodes are
+  never evicted before their descendants, so every surviving chain stays
+  walkable.
+- **Publish without donation**: the scatter publishing new pages returns
+  a fresh pool array (the old one is NOT donated) — earlier-dispatched
+  suffix prefills still hold the previous snapshot, so device-order
+  hazards cannot corrupt a read. The transient cost is one extra pool
+  allocation per publish, bounded by the byte budget.
+
+Determinism contract: with a bf16 KV cache the pooled pages hold exactly
+the bf16 K/V a full prefill would recompute, so greedy decode is
+token-identical with the cache on or off. With ``cfg.kv_int8`` the pages
+store the quantized planes and suffix prefill dequantizes them, so
+suffix-prefill logits see quantization-level drift relative to a full
+prefill (decode already reads the quantized cache either way).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["PrefixStore"]
+
+
+class _PageNode:
+    """One cached page: ``key`` is the page's token tuple, ``page_id`` its
+    row in the device pool. ``refs`` pins it against eviction while an
+    admission pass plans a gather from it."""
+
+    __slots__ = ("key", "parent", "children", "page_id", "refs",
+                 "last_used")
+
+    def __init__(self, key: Tuple[int, ...], parent: "_PageNode",
+                 page_id: int):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_PageNode"] = {}
+        self.page_id = page_id
+        self.refs = 0
+        self.last_used = 0
+
+
+class PrefixStore:
+    """Prefix KV store: host trie index + device page pool.
+
+    ``page`` tokens per page; ``budget_bytes`` caps the pool's HBM
+    footprint (``num_pages`` overrides the derived count — unit tests);
+    ``max_pages`` caps how long a cached prefix may grow (pages past it
+    are neither looked up nor published)."""
+
+    def __init__(self, cfg, page: int = 32,
+                 budget_bytes: int = 64 << 20,
+                 max_pages: int = 0,
+                 num_pages: Optional[int] = None,
+                 mesh=None, metrics=None):
+        import jax
+
+        self._jax = jax
+        self.cfg = cfg
+        self.mesh = mesh
+        self.metrics = metrics
+        self.page = int(page)
+        self.max_pages = int(max_pages)
+        self.budget_bytes = int(budget_bytes)
+        self.page_bytes = self._page_bytes(cfg, self.page)
+        self.num_pages = (int(num_pages) if num_pages is not None
+                          else max(1, self.budget_bytes // self.page_bytes))
+        # cumulative counters (survive reset(): the store's history, not
+        # its contents)
+        self.hits = 0
+        self.partial_hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+        self.inserts = 0
+        self.evictions = 0
+        self.publishes = 0
+        self._publish_fns: Dict[Tuple[int, int], Any] = {}
+        self._clock = 0
+        self._root: Optional[_PageNode] = None
+        self._nodes: List[_PageNode] = []
+        self._free: List[int] = []
+        self.pool: Dict[str, Any] = {}
+        self.reset()
+
+    @staticmethod
+    def _page_bytes(cfg, page: int) -> int:
+        """HBM bytes one page occupies across every cache leaf."""
+        import jax.numpy as jnp
+
+        kv = cfg.n_layers * page * cfg.n_kv_heads * cfg.head_dim
+        if cfg.kv_int8:
+            scales = cfg.n_layers * page * cfg.n_kv_heads * 4
+            return 2 * (kv + scales)          # int8 k+v, f32 ks+vs
+        return 2 * kv * jnp.dtype(cfg.dtype).itemsize
+
+    # -- device pool --------------------------------------------------------
+    def _init_pool(self) -> None:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        shape = (cfg.n_layers, self.num_pages, self.page, cfg.n_kv_heads,
+                 cfg.head_dim)
+        if cfg.kv_int8:
+            pool = {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "ks": jnp.ones(shape[:-1], jnp.float32),
+                    "vs": jnp.ones(shape[:-1], jnp.float32)}
+        else:
+            pool = {"k": jnp.zeros(shape, cfg.dtype),
+                    "v": jnp.zeros(shape, cfg.dtype)}
+        if self.mesh is not None:
+            from gofr_tpu.parallel.sharding import (
+                llama_prefix_pool_specs, prune_specs, shard_pytree)
+            pool = shard_pytree(
+                pool, self.mesh,
+                prune_specs(llama_prefix_pool_specs(kv_int8=cfg.kv_int8),
+                            self.mesh))
+        else:
+            pool = self._jax.device_put(pool)
+        self.pool = pool
+
+    def reset(self) -> None:
+        """Drop every cached prefix and rebuild the pool with fresh device
+        buffers. Called at engine device-state reset: a failed executable
+        may have poisoned any in-flight handle, and the index must not
+        advertise pages whose contents are gone."""
+        self._root = _PageNode((), None, -1)  # type: ignore[arg-type]
+        self._nodes = []
+        self._free = list(range(self.num_pages))
+        self._init_pool()
+        self._set_occupancy()
+
+    # -- host index ---------------------------------------------------------
+    def _touch(self, node: _PageNode) -> None:
+        self._clock += 1
+        node.last_used = self._clock
+
+    def max_lookup_pages(self, prompt_len: int) -> int:
+        """Pages a prompt of this length may reuse: full pages only, and
+        the suffix must keep >= 1 token so the prefill still has a row to
+        sample the first generated token from."""
+        return min(max(0, (prompt_len - 1) // self.page), self.max_pages)
+
+    def lookup(self, tokens: Sequence[int]) -> List[_PageNode]:
+        """Longest cached page chain matching the prompt's head. Bumps LRU
+        on the matched chain; classification/pinning are the caller's
+        (it knows which rung it will actually dispatch)."""
+        chain: List[_PageNode] = []
+        node = self._root
+        for i in range(self.max_lookup_pages(len(tokens))):
+            key = tuple(tokens[i * self.page:(i + 1) * self.page])
+            child = node.children.get(key)
+            if child is None:
+                break
+            self._touch(child)
+            chain.append(child)
+            node = child
+        return chain
+
+    def classify(self, matched: int, requestable: int) -> str:
+        """Count one lookup outcome: ``hit`` = the full requestable prefix
+        was cached, ``partial`` = some of it, ``miss`` = none."""
+        if matched <= 0:
+            result = "miss"
+            self.misses += 1
+        elif matched >= requestable:
+            result = "hit"
+            self.hits += 1
+        else:
+            result = "partial"
+            self.partial_hits += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_tpu_prefix_lookup_total",
+                                           result=result)
+        return result
+
+    def record_saved(self, tokens: int) -> None:
+        """Prompt tokens whose prefill was skipped via reuse."""
+        self.tokens_saved += tokens
+        if self.metrics is not None:
+            self.metrics.delta_updown_counter(
+                "app_tpu_prefix_tokens_saved_total", float(tokens))
+
+    def acquire(self, nodes: Sequence[_PageNode]) -> None:
+        for node in nodes:
+            node.refs += 1
+
+    def release(self, nodes: Sequence[_PageNode]) -> None:
+        for node in nodes:
+            node.refs = max(0, node.refs - 1)
+
+    def _evict_one(self) -> Optional[int]:
+        """Free the LRU unpinned leaf's page. None when everything is
+        pinned (the caller publishes fewer pages — never blocks)."""
+        victim: Optional[_PageNode] = None
+        for node in self._nodes:
+            if node.children or node.refs > 0:
+                continue
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        if victim is None:
+            return None
+        del victim.parent.children[victim.key]
+        self._nodes.remove(victim)
+        self.evictions += 1
+        return victim.page_id
+
+    def _alloc_page(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        return self._evict_one()
+
+    def insert(self, tokens: Sequence[int],
+               want_pages: int) -> List[Tuple[int, bool]]:
+        """Walk/create the chain for the prompt's first ``want_pages``
+        pages. Returns ``(page_id, is_new)`` per page — ``is_new=False``
+        pages already hold their KV (dedup: the publish scatter skips
+        them). Stops early when no page can be allocated (pool exhausted
+        and everything pinned)."""
+        out: List[Tuple[int, bool]] = []
+        node = self._root
+        for i in range(min(want_pages, self.max_pages)):
+            key = tuple(tokens[i * self.page:(i + 1) * self.page])
+            child = node.children.get(key)
+            if child is None:
+                page_id = self._alloc_page()
+                if page_id is None:
+                    break
+                child = _PageNode(key, node, page_id)
+                node.children[key] = child
+                self._nodes.append(child)
+                self.inserts += 1
+                out.append((page_id, True))
+            else:
+                out.append((child.page_id, False))
+            self._touch(child)
+            node = child
+        self._set_occupancy()
+        return out
+
+    # -- device publish -----------------------------------------------------
+    def publish_ready(self, nb: int, lb: int) -> bool:
+        return (nb, lb) in self._publish_fns
+
+    def _publish_fn(self, nb: int, lb: int):
+        """Scatter of up to ``lb // page`` pages per prefill row from a
+        small-cache (L, nb, lb, ...) into the pool. Page ids ==
+        ``num_pages`` are dropped (already-cached pages, short prompts).
+        The pool argument is NOT donated — see the module docstring."""
+        fn = self._publish_fns.get((nb, lb))
+        if fn is None:
+            import jax
+
+            n_pages = min(lb // self.page, self.max_pages)
+            page = self.page
+
+            def publish(pool, small, flat_ids):
+                out = {}
+                for name in pool:
+                    leaf = small[name]          # (L, nb, lb, ...)
+                    sel = leaf[:, :, :n_pages * page]
+                    sel = sel.reshape(leaf.shape[0], nb * n_pages, page,
+                                      *leaf.shape[3:])
+                    out[name] = pool[name].at[:, flat_ids].set(
+                        sel, mode="drop")
+                return out
+
+            fn = jax.jit(publish)
+            self._publish_fns[(nb, lb)] = fn
+        return fn
+
+    def publish(self, small, flat_ids, nb: int, lb: int) -> None:
+        """Publish freshly prefilled pages into the pool. ``flat_ids`` is
+        the (nb * (lb // page),) page-id vector from :meth:`insert`, with
+        ``num_pages`` marking don't-write entries."""
+        import jax.numpy as jnp
+
+        self.pool = self._publish_fn(nb, lb)(
+            self.pool, small, jnp.asarray(flat_ids))
+        self.publishes += 1
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def _set_occupancy(self) -> None:
+        if self.metrics is not None and self.num_pages:
+            self.metrics.set_gauge("app_tpu_prefix_cache_occupancy",
+                                   self.used_pages / self.num_pages)
+
+    def stats(self) -> Dict[str, Any]:
+        lookups = self.hits + self.partial_hits + self.misses
+        return {
+            "page_tokens": self.page,
+            "num_pages": self.num_pages,
+            "used_pages": self.used_pages,
+            "max_pages_per_prefix": self.max_pages,
+            "budget_bytes": self.budget_bytes,
+            "page_bytes": self.page_bytes,
+            "pool_bytes": self.num_pages * self.page_bytes,
+            "occupancy": (round(self.used_pages / self.num_pages, 6)
+                          if self.num_pages else 0.0),
+            "lookups": {"total": lookups, "hit": self.hits,
+                        "partial": self.partial_hits, "miss": self.misses},
+            "tokens_saved": self.tokens_saved,
+            "inserts": self.inserts,
+            "evictions": self.evictions,
+            "publishes": self.publishes,
+        }

@@ -1,0 +1,329 @@
+"""Prefix KV reuse (ISSUE 4): radix prefix store + suffix-only prefill.
+
+The determinism contract is the load-bearing property: with a bf16 KV
+cache, greedy decode through the engine must be TOKEN-IDENTICAL with the
+prefix cache on or off — the pooled pages hold exactly the K/V a full
+prefill would recompute. Everything else (eviction, pinning, dedup,
+reset) protects that contract under churn.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.tpu.generate import GenerationEngine
+from gofr_tpu.tpu.prefix_cache import PrefixStore
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _make_engine(cfg, params, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    engine = GenerationEngine(cfg, params, logger=container.logger,
+                              metrics=container.metrics, **kwargs)
+    return engine, container
+
+
+# -- PrefixStore unit tests (host index only; tiny pool) ---------------------
+
+def test_store_lookup_insert_roundtrip(setup):
+    cfg, _ = setup
+    store = PrefixStore(cfg, page=4, max_pages=4, num_pages=8)
+    prompt = list(range(1, 18))                   # 17 tokens -> 4 full pages
+    assert store.lookup(prompt) == []
+    assert store.max_lookup_pages(len(prompt)) == 4
+    # a prompt of exactly N pages may only reuse N-1 (suffix keeps >=1)
+    assert store.max_lookup_pages(16) == 3
+    pages = store.insert(prompt, 4)
+    assert [is_new for _, is_new in pages] == [True] * 4
+    chain = store.lookup(prompt)
+    assert [n.page_id for n in chain] == [p for p, _ in pages]
+    # re-insert dedups: same ids, nothing new
+    again = store.insert(prompt, 4)
+    assert again == [(p, False) for p, _ in pages]
+    # a prompt diverging at page 2 shares page 1 only
+    other = prompt[:4] + [99] * 13
+    assert len(store.lookup(other)) == 1
+
+
+def test_store_eviction_lru_and_refcount_pinning(setup):
+    cfg, _ = setup
+    store = PrefixStore(cfg, page=4, max_pages=2, num_pages=2)
+    a, b = [1] * 9, [2] * 9
+    store.insert(a, 2)                            # fills both pages
+    assert store.used_pages == 2
+    chain_a = store.lookup(a)
+    assert len(chain_a) == 2
+
+    # everything pinned: insert must NOT evict, it degrades gracefully
+    store.acquire(chain_a)
+    assert store.insert(b, 2) == []
+    assert store.evictions == 0
+    assert len(store.lookup(a)) == 2              # a's chain survived
+
+    # unpinned: leaf-only LRU eviction — the interior page (with a child)
+    # is protected, so inserting one page of b evicts a's LEAF
+    store.release(chain_a)
+    store.lookup(b)                               # bump b's (empty) path
+    pages_b = store.insert(b, 1)
+    assert len(pages_b) == 1 and pages_b[0][1] is True
+    assert store.evictions == 1
+    assert len(store.lookup(a)) == 1              # interior page survived
+    assert len(store.lookup(b)) == 1
+
+
+def test_store_budget_sizes_pool(setup):
+    cfg, _ = setup
+    tight = PrefixStore(cfg, page=4, max_pages=2,
+                        budget_bytes=3 * PrefixStore._page_bytes(cfg, 4))
+    assert tight.num_pages == 3
+    assert tight.stats()["pool_bytes"] == 3 * tight.page_bytes
+
+
+def test_store_reset_clears_index_keeps_counters(setup):
+    cfg, _ = setup
+    store = PrefixStore(cfg, page=4, max_pages=2, num_pages=4)
+    store.insert([1] * 9, 2)
+    inserts = store.inserts
+    store.reset()
+    assert store.used_pages == 0
+    assert store.lookup([1] * 9) == []
+    assert store.inserts == inserts               # history survives
+
+
+# -- engine integration: determinism contract --------------------------------
+
+def test_greedy_token_identity_cache_on_off(setup):
+    """Full hits, partial hits, and page-boundary prompts all decode the
+    exact token stream a cache-off engine produces."""
+    cfg, params = setup
+    base = list(range(1, 11))          # 10 tokens: 2 full pages + tail
+    partial = base[:8] + [31, 32, 33]  # shares both pages, new tail
+    boundary = base[:8]                # exactly 2 pages -> reuse 1 page
+
+    async def run(prefix_cache):
+        engine, _ = _make_engine(cfg, params, prefix_cache=prefix_cache,
+                                 prefix_page=4)
+        await engine.start()
+        try:
+            outs = []
+            for prompt in (base, base, partial, boundary):
+                outs.append(await asyncio.wait_for(
+                    engine.generate(prompt, max_new_tokens=6), 60.0))
+            return outs, engine.stats()
+        finally:
+            await engine.stop()
+
+    ref, _ = asyncio.run(run(False))
+    out, stats = asyncio.run(run(True))
+    assert out == ref
+    lookups = stats["prefix_cache"]["lookups"]
+    assert lookups["miss"] >= 1
+    assert lookups["hit"] + lookups["partial"] >= 2
+    assert stats["prefix_cache"]["tokens_saved"] > 0
+
+
+def test_suffix_prefill_dispatches_fewer_prompt_flops(setup):
+    """Acceptance criterion: with a shared prefix the suffix path must
+    dispatch strictly fewer prompt tokens to prefill executables than
+    full prefill would — prefill FLOPs scale with bucket tokens."""
+    cfg, params = setup
+    shared = list(range(1, 9))         # 2 pages of 4
+    prompts = [shared + [50 + i, 60 + i] for i in range(4)]
+
+    async def run(prefix_cache):
+        engine, _ = _make_engine(cfg, params, prefix_cache=prefix_cache,
+                                 prefix_page=4)
+        await engine.start()
+        try:
+            outs = []
+            for prompt in prompts:     # sequential: later ones hit
+                outs.append(await asyncio.wait_for(
+                    engine.generate(prompt, max_new_tokens=4), 60.0))
+            return outs, engine.stats()
+        finally:
+            await engine.stop()
+
+    ref, off = asyncio.run(run(False))
+    out, on = asyncio.run(run(True))
+    assert out == ref
+    assert on["prefill_bucket_tokens"] < off["prefill_bucket_tokens"]
+    # 3 of 4 prompts reused the 8-token prefix
+    assert on["prefix_cache"]["tokens_saved"] == 24
+
+
+def test_concurrent_admissions_share_one_prefix(setup):
+    """Same-pass identical prefixes: all miss at lookup (no KV exists
+    yet), the first row's publish wins, later GENERATIONS hit."""
+    cfg, params = setup
+    shared = list(range(1, 9))
+    batch = [shared + [70 + i] for i in range(3)]
+
+    async def run(prefix_cache):
+        engine, _ = _make_engine(cfg, params, prefix_cache=prefix_cache,
+                                 prefix_page=4)
+        await engine.start()
+        try:
+            first = await asyncio.wait_for(asyncio.gather(*[
+                engine.generate(p, max_new_tokens=4) for p in batch]),
+                120.0)
+            second = await asyncio.wait_for(asyncio.gather(*[
+                engine.generate(p, max_new_tokens=4) for p in batch]),
+                120.0)
+            return first + second, engine.stats()
+        finally:
+            await engine.stop()
+
+    ref, _ = asyncio.run(run(False))
+    out, stats = asyncio.run(run(True))
+    assert out == ref
+    store = stats["prefix_cache"]
+    # the shared 2-page prefix occupies exactly one chain, not one per row
+    assert store["inserts"] == 2
+    assert store["lookups"]["hit"] >= 3        # the second wave
+    assert store["used_pages"] == 2
+
+
+def test_eviction_under_tight_budget_keeps_outputs_exact(setup):
+    """A pool too small for the working set must evict and recompute,
+    never corrupt: outputs stay identical to cache-off."""
+    cfg, params = setup
+    prompts = [[10 * k + i for i in range(1, 11)] for k in range(4)]
+
+    async def run(prefix_cache, **kw):
+        engine, _ = _make_engine(cfg, params, prefix_cache=prefix_cache,
+                                 prefix_page=4, **kw)
+        if prefix_cache:
+            # shrink the pool to 3 pages: each prompt wants 2, so serving
+            # all four churns through eviction
+            engine._prefix.num_pages = 3
+            engine._prefix.reset()
+        await engine.start()
+        try:
+            outs = []
+            for prompt in prompts * 2:
+                outs.append(await asyncio.wait_for(
+                    engine.generate(prompt, max_new_tokens=4), 60.0))
+            return outs, engine.stats()
+        finally:
+            await engine.stop()
+
+    ref, _ = asyncio.run(run(False))
+    out, stats = asyncio.run(run(True))
+    assert out == ref
+    store = stats["prefix_cache"]
+    assert store["evictions"] > 0
+    assert store["used_pages"] <= 3
+
+
+def test_reset_device_state_invalidates_store(setup):
+    cfg, params = setup
+    prompt = list(range(1, 11))
+
+    async def run():
+        engine, _ = _make_engine(cfg, params, prefix_cache=True,
+                                 prefix_page=4)
+        await engine.start()
+        try:
+            ref = await asyncio.wait_for(
+                engine.generate(prompt, max_new_tokens=4), 60.0)
+            assert engine._prefix.used_pages == 2
+            engine._reset_device_state()
+            assert engine._prefix.used_pages == 0
+            assert engine._prefix.lookup(prompt) == []
+            # the store repopulates and still serves exact tokens
+            out1 = await asyncio.wait_for(
+                engine.generate(prompt, max_new_tokens=4), 60.0)
+            out2 = await asyncio.wait_for(
+                engine.generate(prompt, max_new_tokens=4), 60.0)
+            assert out1 == ref and out2 == ref
+            assert engine._prefix.used_pages == 2
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_flight_recorder_carries_cached_prefix_len(setup):
+    cfg, params = setup
+    prompt = list(range(1, 11))
+
+    async def run():
+        engine, _ = _make_engine(cfg, params, prefix_cache=True,
+                                 prefix_page=4)
+        await engine.start()
+        try:
+            await engine.generate(prompt, max_new_tokens=3)
+            await engine.generate(prompt, max_new_tokens=3)
+        finally:
+            await engine.stop()
+        recent = engine.recorder.snapshot(limit=2)["recent"]
+        lens = sorted(r["cached_prefix_len"] for r in recent)
+        assert lens == [0, 8]      # miss then 2-page hit
+        assert "prefix_cache" in engine.statusz()["stats"]
+        assert "prefix_cache" in engine.xlaz()
+
+    asyncio.run(run())
+
+
+def test_prefix_metrics_emitted(setup):
+    cfg, params = setup
+    prompt = list(range(1, 11))
+
+    async def run():
+        engine, container = _make_engine(cfg, params, prefix_cache=True,
+                                         prefix_page=4)
+        await engine.start()
+        try:
+            await engine.generate(prompt, max_new_tokens=3)
+            await engine.generate(prompt, max_new_tokens=3)
+        finally:
+            await engine.stop()
+        metrics = container.metrics
+        assert metrics.value("app_tpu_prefix_lookup_total",
+                             result="miss") == 1
+        assert metrics.value("app_tpu_prefix_lookup_total",
+                             result="hit") == 1
+        assert metrics.value("app_tpu_prefix_tokens_saved_total") == 8
+        assert metrics.value("app_tpu_prefix_cache_occupancy") > 0
+
+    asyncio.run(run())
+
+
+def test_prefix_cache_sharded_pool(setup):
+    """The page pool takes the same kv-head tp spec as the main cache and
+    suffix prefill stays exact on a dp x tp mesh."""
+    from gofr_tpu.parallel import make_mesh
+    cfg, params = setup
+    mesh = make_mesh({"dp": 4, "tp": 2})   # tp=2 divides tiny's 2 kv heads
+    prompt = list(range(1, 11))
+
+    async def run(prefix_cache):
+        engine, _ = _make_engine(cfg, params, mesh=mesh,
+                                 prefix_cache=prefix_cache, prefix_page=4,
+                                 max_slots=4)
+        await engine.start()
+        try:
+            outs = []
+            for _ in range(2):
+                outs.append(await asyncio.wait_for(
+                    engine.generate(prompt, max_new_tokens=4), 120.0))
+            return outs
+        finally:
+            await engine.stop()
+
+    ref = asyncio.run(run(False))
+    out = asyncio.run(run(True))
+    assert out == ref
