@@ -1,0 +1,48 @@
+"""Async task discipline: spawn background coroutines without losing
+their deaths.
+
+``asyncio.ensure_future``/``create_task`` detaches a coroutine; if
+nobody awaits it, an escaped exception is only reported by the loop's
+lost-task handler at GC time — a crashed subscriber loop or cron firing
+looks exactly like a quiet one. Every fire-and-forget spawn in this
+framework goes through :func:`spawn_logged` instead (enforced by
+graftcheck rule GT002, docs/references/static-analysis.md): the task
+gets a done-callback that logs the exception and increments
+``app_async_task_failures_total{task=...}``, so a dying background loop
+shows up on a dashboard and not just in a post-mortem.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def spawn_logged(coro, logger=None, name: str = "task",
+                 metrics=None) -> asyncio.Task:
+    """Schedule ``coro`` as a task whose failure is observed.
+
+    Cancellation is not a failure (it is how this framework stops its
+    loops); any other escaped exception is logged under ``name`` and
+    counted in ``app_async_task_failures_total{task=name}``. Returns the
+    task, so callers can still keep a handle for cancellation.
+    """
+    task = asyncio.ensure_future(coro)
+    try:
+        task.set_name(name)
+    except AttributeError:  # pragma: no cover - py<3.8 compat
+        pass
+
+    def _observe(done: asyncio.Task) -> None:
+        if done.cancelled():
+            return
+        exc = done.exception()
+        if exc is None:
+            return
+        if logger is not None:
+            logger.error("background task %s died: %r", name, exc)
+        if metrics is not None:
+            metrics.increment_counter("app_async_task_failures_total",
+                                      task=name)
+
+    task.add_done_callback(_observe)
+    return task

@@ -1,0 +1,51 @@
+"""graftcheck: serving-aware static analysis for the gofr_tpu tree.
+
+Run it as ``python -m gofr_tpu.analysis`` (or ``scripts/graftcheck.py``);
+``scripts/tier1.sh`` runs it before the pytest sweep. Rule catalog and
+suppression syntax: ``docs/references/static-analysis.md``.
+
+Rules:
+
+- **GT001 event-loop-block** — blocking calls (``time.sleep``, device
+  syncs, sync I/O, thread-lock acquires) reachable from an ``async def``
+  without a ``run_in_executor``/``to_thread`` hop.
+- **GT002 fire-and-forget-task** — ``ensure_future``/``create_task``
+  results dropped with no exception-handling done-callback; use
+  :func:`gofr_tpu.aio.spawn_logged`.
+- **GT003 recompile-hazard** — jit-per-call wrappers, unhashable static
+  args, shape-derived values at non-static positions, raw-``len()``
+  device shapes.
+- **GT004 traced-side-effects** — print/logging/metrics and tracer-
+  dependent Python ``if`` inside jit-traced bodies.
+- **GT005 metric-discipline** — the metric-name + docs-drift lint
+  (formerly ``scripts/lint_metrics.py``).
+"""
+
+from gofr_tpu.analysis.engine import (
+    DEFAULT_BASELINE,
+    Finding,
+    ModuleInfo,
+    PACKAGE,
+    ROOT,
+    Report,
+    Rule,
+    load_baseline,
+    run,
+    write_baseline,
+)
+from gofr_tpu.analysis.rules import ALL_RULES, default_rules
+
+__all__ = [
+    "ALL_RULES",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "ModuleInfo",
+    "PACKAGE",
+    "ROOT",
+    "Report",
+    "Rule",
+    "default_rules",
+    "load_baseline",
+    "run",
+    "write_baseline",
+]

@@ -1,0 +1,94 @@
+"""graftcheck CLI: ``python -m gofr_tpu.analysis [paths...]``.
+
+Exit 0 = no unsuppressed findings beyond the committed baseline;
+exit 1 = new findings (printed one per line as ``path:line: RULE msg``)
+or unparseable files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from gofr_tpu.analysis import engine
+from gofr_tpu.analysis.rules import ALL_RULES, default_rules
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m gofr_tpu.analysis",
+        description="graftcheck: serving-aware static analysis "
+                    "(rule catalog: docs/references/static-analysis.md)")
+    parser.add_argument(
+        "paths", nargs="*", type=pathlib.Path,
+        help="files/directories to scan (default: the gofr_tpu package)")
+    parser.add_argument(
+        "--baseline", type=pathlib.Path, default=engine.DEFAULT_BASELINE,
+        help="grandfathered-findings file "
+             "(default: scripts/graftcheck_baseline.json)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every unsuppressed finding")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current findings and exit 0")
+    parser.add_argument(
+        "--select", default="",
+        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument(
+        "--docs", type=pathlib.Path, default=None,
+        help="metrics catalog for GT005 "
+             "(default: docs/quick-start/observability.md)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit")
+    opts = parser.parse_args(argv)
+
+    if opts.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.rule_id}  {cls.title}")
+        return 0
+
+    select = [token.strip() for token in opts.select.split(",")
+              if token.strip()] or None
+    options = {}
+    if opts.docs is not None:
+        options["docs_catalog"] = opts.docs
+    rules = default_rules(select=select, **options)
+
+    paths = opts.paths or [engine.PACKAGE]
+    baseline = {} if (opts.no_baseline or opts.write_baseline) \
+        else engine.load_baseline(opts.baseline)
+    report = engine.run(paths=paths, rules=rules, baseline=baseline)
+
+    if opts.write_baseline:
+        engine.write_baseline(opts.baseline, report.new_findings)
+        print(f"graftcheck: wrote {len(report.new_findings)} grandfathered "
+              f"finding(s) to {opts.baseline}")
+        return 0
+
+    for error in report.parse_errors:
+        print(error, file=sys.stderr)
+    for finding in report.new_findings:
+        print(finding.render(), file=sys.stderr)
+    if report.stale_baseline:
+        # informational: the debt shrank — tighten the pin so it can't grow
+        print(f"graftcheck: note: {len(report.stale_baseline)} baseline "
+              f"entr{'y is' if len(report.stale_baseline) == 1 else 'ies are'}"
+              f" stale (fixed?) — regenerate with --write-baseline",
+              file=sys.stderr)
+    if report.exit_code:
+        print(f"graftcheck: {len(report.new_findings)} new finding(s) "
+              f"({report.files_scanned} files, "
+              f"{len(report.baselined)} baselined, "
+              f"{report.suppressed} pragma-suppressed)", file=sys.stderr)
+        return 1
+    print(f"graftcheck: OK ({report.files_scanned} files, "
+          f"{len(report.baselined)} baselined, "
+          f"{report.suppressed} pragma-suppressed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

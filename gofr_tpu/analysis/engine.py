@@ -1,0 +1,264 @@
+"""graftcheck rule engine: repo-aware AST analysis with pragmas + baseline.
+
+The serving stack's latency story rests on invariants nothing at runtime
+can enforce cheaply — the asyncio loop must never block on a device sync,
+fire-and-forget tasks must not swallow exceptions, jitted call sites must
+not smuggle in recompile hazards. graftcheck machine-checks them ahead of
+deploy; PR 3's compile ledger can only *count* recompile storms after one
+already stalled traffic.
+
+Architecture:
+
+- :class:`ModuleInfo` — one parsed source file: AST, source lines,
+  ``# graftcheck: ignore[RULE]`` pragma map, import-alias table, and a
+  child→parent node map (``ast`` does not keep parents).
+- :class:`Rule` — per-rule ``check_module`` (file-local findings) and
+  ``finalize`` (cross-file findings, e.g. GT005's registered-vs-observed
+  metric join).
+- :func:`run` — walk a tree, apply rules, subtract pragma suppressions,
+  then subtract the committed baseline (grandfathered findings are
+  *pinned by count per fingerprint*: fixing one and adding another at the
+  same site still fails).
+
+Fingerprints deliberately exclude line numbers so unrelated edits above a
+grandfathered finding don't resurrect it; they include the enclosing
+function so two distinct sites never share one baseline slot by accident.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+ROOT = pathlib.Path(__file__).resolve().parents[2]
+PACKAGE = ROOT / "gofr_tpu"
+DEFAULT_BASELINE = ROOT / "scripts" / "graftcheck_baseline.json"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftcheck:\s*ignore\[([A-Za-z0-9_*,\s]+)\]")
+_PRAGMA_FILE_RE = re.compile(
+    r"#\s*graftcheck:\s*ignore-file\[([A-Za-z0-9_*,\s]+)\]")
+
+
+@dataclass
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str            # "GT001"
+    path: str            # repo-relative posix path
+    line: int            # 1-based
+    message: str         # human-readable, printed as path:line: RULE msg
+    severity: str = "error"
+    key: str = ""        # stable fingerprint token (defaults to message)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.key or self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+class ModuleInfo:
+    """A parsed module plus the derived tables every rule needs."""
+
+    def __init__(self, path: pathlib.Path, source: str):
+        self.path = path
+        try:
+            self.relpath = path.resolve().relative_to(ROOT).as_posix()
+        except ValueError:
+            self.relpath = path.as_posix()
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.ignores: Dict[int, Set[str]] = {}
+        self.file_ignores: Set[str] = set()
+        for lineno, text in enumerate(self.lines, start=1):
+            match = _PRAGMA_RE.search(text)
+            if match:
+                tags = {token.strip()
+                        for token in match.group(1).split(",")}
+                self.ignores.setdefault(lineno, set()).update(tags)
+                # a pragma on a comment-only line covers the statement it
+                # precedes: skip past the rest of the comment block
+                if text.lstrip().startswith("#"):
+                    nxt = lineno
+                    while nxt < len(self.lines) and (
+                            not self.lines[nxt].strip()
+                            or self.lines[nxt].lstrip().startswith("#")):
+                        nxt += 1
+                    if nxt < len(self.lines):
+                        self.ignores.setdefault(nxt + 1, set()).update(tags)
+            match = _PRAGMA_FILE_RE.search(text)
+            if match:
+                self.file_ignores.update(
+                    token.strip() for token in match.group(1).split(","))
+        # import alias tables: "np" -> "numpy", "sleep" -> "time.sleep"
+        self.import_aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.import_aliases[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_ignores or "*" in self.file_ignores:
+            return True
+        # check the finding's own line plus the line above: findings inside
+        # a multi-line statement report their continuation line, one past
+        # the statement start the pragma covers
+        for lineno in (finding.line, finding.line - 1):
+            tags = self.ignores.get(lineno, ())
+            if finding.rule in tags or "*" in tags:
+                return True
+        return False
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Resolve ``np.asarray`` → ``numpy.asarray`` through the module's
+        import aliases; plain names resolve through from-imports. Returns
+        None for expressions rooted at something other than a Name."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.import_aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cursor = self.parents.get(node)
+        while cursor is not None:
+            if isinstance(cursor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cursor
+            cursor = self.parents.get(cursor)
+        return None
+
+
+class Rule:
+    """Base rule. Subclasses set ``rule_id``/``title`` and override
+    ``check_module`` and/or ``finalize``."""
+
+    rule_id = "GT000"
+    title = ""
+    severity = "error"
+
+    def check_module(self, module: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, modules: Sequence[ModuleInfo]) -> Iterable[Finding]:
+        return ()
+
+
+@dataclass
+class Report:
+    """Outcome of one analysis run."""
+
+    new_findings: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    stale_baseline: List[str] = field(default_factory=list)
+    parse_errors: List[str] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.new_findings or self.parse_errors) else 0
+
+
+def load_baseline(path: pathlib.Path) -> Dict[str, int]:
+    try:
+        payload = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return {}
+    counts = payload.get("counts", {})
+    return {str(k): int(v) for k, v in counts.items()}
+
+
+def write_baseline(path: pathlib.Path, findings: Sequence[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    for finding in findings:
+        counts[finding.fingerprint] = counts.get(finding.fingerprint, 0) + 1
+    payload = {
+        "_comment": (
+            "graftcheck grandfathered findings, pinned by count per "
+            "fingerprint. Regenerate with: "
+            "python -m gofr_tpu.analysis --write-baseline. Shrink it when "
+            "you fix one; never grow it for new code."),
+        "version": 1,
+        "counts": {k: counts[k] for k in sorted(counts)},
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def iter_python_files(paths: Sequence[pathlib.Path]) -> List[pathlib.Path]:
+    out: List[pathlib.Path] = []
+    for path in paths:
+        if path.is_dir():
+            out.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            out.append(path)
+    return out
+
+
+def run(paths: Optional[Sequence[pathlib.Path]] = None,
+        rules: Optional[Sequence[Rule]] = None,
+        baseline: Optional[Dict[str, int]] = None) -> Report:
+    """Run ``rules`` over every ``*.py`` under ``paths``.
+
+    ``baseline`` maps fingerprints to grandfathered counts; within one
+    fingerprint the first N findings are baselined and the rest are new.
+    """
+    if rules is None:
+        from gofr_tpu.analysis.rules import default_rules
+        rules = default_rules()
+    if paths is None:
+        paths = [PACKAGE]
+    report = Report()
+    modules: List[ModuleInfo] = []
+    for path in iter_python_files(paths):
+        try:
+            source = path.read_text(encoding="utf-8")
+            modules.append(ModuleInfo(path, source))
+        except (OSError, SyntaxError) as exc:
+            report.parse_errors.append(f"{path}: unparseable: {exc}")
+    report.files_scanned = len(modules)
+
+    module_by_rel = {m.relpath: m for m in modules}
+    raw: List[Finding] = []
+    for rule in rules:
+        for module in modules:
+            raw.extend(rule.check_module(module))
+        raw.extend(rule.finalize(modules))
+
+    kept: List[Finding] = []
+    for finding in raw:
+        module = module_by_rel.get(finding.path)
+        if module is not None and module.suppressed(finding):
+            report.suppressed += 1
+        else:
+            kept.append(finding)
+
+    budget = dict(baseline or {})
+    for finding in sorted(kept, key=lambda f: (f.path, f.line, f.rule)):
+        if budget.get(finding.fingerprint, 0) > 0:
+            budget[finding.fingerprint] -= 1
+            report.baselined.append(finding)
+        else:
+            report.new_findings.append(finding)
+    report.stale_baseline = sorted(
+        fp for fp, remaining in budget.items() if remaining > 0)
+    return report

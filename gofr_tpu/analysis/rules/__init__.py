@@ -1,0 +1,36 @@
+"""graftcheck rule registry. Rule catalog: docs/references/static-analysis.md."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from gofr_tpu.analysis.engine import Rule
+from gofr_tpu.analysis.rules.gt001_event_loop import EventLoopBlockRule
+from gofr_tpu.analysis.rules.gt002_tasks import FireAndForgetRule
+from gofr_tpu.analysis.rules.gt003_recompile import RecompileHazardRule
+from gofr_tpu.analysis.rules.gt004_traced_effects import TracedSideEffectsRule
+from gofr_tpu.analysis.rules.gt005_metrics import MetricDisciplineRule
+
+ALL_RULES = (
+    EventLoopBlockRule,
+    FireAndForgetRule,
+    RecompileHazardRule,
+    TracedSideEffectsRule,
+    MetricDisciplineRule,
+)
+
+
+def default_rules(select: Optional[Sequence[str]] = None,
+                  **options) -> List[Rule]:
+    """Instantiate the rule set, optionally filtered to ``select`` ids.
+    ``options`` are forwarded to rules that accept them (GT005 takes
+    ``docs_catalog``)."""
+    rules: List[Rule] = []
+    for cls in ALL_RULES:
+        if select and cls.rule_id not in select:
+            continue
+        if cls is MetricDisciplineRule and "docs_catalog" in options:
+            rules.append(cls(docs_catalog=options["docs_catalog"]))
+        else:
+            rules.append(cls())
+    return rules

@@ -1,0 +1,186 @@
+"""GT005 metric discipline: the static metric-name lint as a graftcheck
+rule.
+
+Formerly ``scripts/lint_metrics.py`` (that script is now a thin shim over
+this module). The checks are unchanged:
+
+1. every literal metric name matches the OpenMetrics charset
+   ``[a-zA-Z_][a-zA-Z0-9_]*``;
+2. every name carries the ``app_`` namespace prefix, except the
+   intentionally-unprefixed process runtime gauges in
+   ``ALLOW_UNPREFIXED``;
+3. every observed name is registered somewhere in the scanned tree — a
+   typo'd observation is silently dropped at runtime by Manager's
+   error-log-and-continue policy, so it must fail CI instead;
+4. every registered ``app_``-prefixed name appears in the metrics
+   catalog (``docs/quick-start/observability.md`` by default) — the
+   docs-drift gate.
+
+Checks 1-2 are per-file findings (pragma-suppressible); 3-4 need the
+whole tree and run in ``finalize``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from gofr_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    ROOT,
+    Rule,
+)
+
+DOCS_CATALOG = ROOT / "docs" / "quick-start" / "observability.md"
+
+NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# any app_-namespaced token in the docs counts as "documented" — rows in
+# the catalog table, prose mentions, and code samples all qualify
+DOC_NAME_RE = re.compile(r"\bapp_[a-zA-Z0-9_]+\b")
+
+# process-runtime gauges predating the app_ namespace convention; kept
+# unprefixed for parity with common node-exporter dashboards
+ALLOW_UNPREFIXED = {
+    "threads_total",
+    "memory_rss_bytes",
+    "gc_objects",
+    "uptime_seconds",
+}
+
+REGISTER_METHODS = {
+    "new_counter",
+    "new_updown_counter",
+    "new_histogram",
+    "new_gauge",
+}
+OBSERVE_METHODS = {
+    "increment_counter",
+    "delta_updown_counter",
+    "record_histogram",
+    "set_gauge",
+}
+
+
+def _metric_calls(tree: ast.AST):
+    """Yield (method, name, lineno) for metrics calls with a literal
+    first argument. Non-literal names (dynamic dispatch) are skipped —
+    the lint is intentionally conservative."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        method = func.attr
+        if method not in REGISTER_METHODS | OBSERVE_METHODS:
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield method, first.value, node.lineno
+
+
+class MetricDisciplineRule(Rule):
+    rule_id = "GT005"
+    title = "metric-discipline"
+    severity = "error"
+
+    def __init__(self, docs_catalog: Optional[pathlib.Path] = None):
+        self.docs_catalog = pathlib.Path(docs_catalog or DOCS_CATALOG)
+        self._registered: Set[str] = set()
+        self._observed: List[Tuple[str, int, str]] = []  # (path, line, name)
+
+    def check_module(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for method, name, lineno in _metric_calls(module.tree):
+            if not NAME_RE.match(name):
+                findings.append(Finding(
+                    rule=self.rule_id, path=module.relpath, line=lineno,
+                    message=(f"metric {name!r} violates the OpenMetrics "
+                             f"charset [a-zA-Z_][a-zA-Z0-9_]*"),
+                    key=f"charset {name}"))
+            if not name.startswith("app_") and name not in ALLOW_UNPREFIXED:
+                findings.append(Finding(
+                    rule=self.rule_id, path=module.relpath, line=lineno,
+                    message=(f"metric {name!r} missing the app_ namespace "
+                             f"prefix (or add it to ALLOW_UNPREFIXED)"),
+                    key=f"prefix {name}"))
+            if method in REGISTER_METHODS:
+                self._registered.add(name)
+            else:
+                self._observed.append((module.relpath, lineno, name))
+        return findings
+
+    def finalize(self, modules) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for rel, lineno, name in self._observed:
+            if name not in self._registered:
+                findings.append(Finding(
+                    rule=self.rule_id, path=rel, line=lineno,
+                    message=(f"metric {name!r} is observed but never "
+                             f"registered — Manager drops it at runtime"),
+                    key=f"unregistered {name}"))
+        try:
+            documented = set(DOC_NAME_RE.findall(
+                self.docs_catalog.read_text(encoding="utf-8")))
+        except OSError as exc:
+            docs_rel = self._docs_rel()
+            return findings + [Finding(
+                rule=self.rule_id, path=docs_rel, line=1,
+                message=f"unreadable metrics catalog: {exc}",
+                key="catalog unreadable")]
+        docs_rel = self._docs_rel()
+        for name in sorted(self._registered):
+            if name.startswith("app_") and name not in documented:
+                findings.append(Finding(
+                    rule=self.rule_id, path=docs_rel, line=1,
+                    message=(f"metric {name!r} is registered in source "
+                             f"but missing from the metrics catalog — "
+                             f"document it (or remove the registration)"),
+                    key=f"undocumented {name}"))
+        return findings
+
+    def _docs_rel(self) -> str:
+        try:
+            return self.docs_catalog.resolve().relative_to(ROOT).as_posix()
+        except ValueError:
+            return str(self.docs_catalog)
+
+    @property
+    def registered_count(self) -> int:
+        return len(self._registered)
+
+
+def main(argv=None) -> int:
+    """Standalone entry preserving the historical ``scripts/lint_metrics.py``
+    interface: same flags, same messages, exit 0 clean / 1 violations."""
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--docs", type=pathlib.Path, default=DOCS_CATALOG,
+        help="metrics catalog to check app_ names against "
+             "(default: docs/quick-start/observability.md)")
+    opts = parser.parse_args(argv)
+
+    from gofr_tpu.analysis import engine
+    rule = MetricDisciplineRule(docs_catalog=opts.docs)
+    report = engine.run(paths=[engine.PACKAGE], rules=[rule], baseline={})
+    problems = [f.render().replace(f"{rule.rule_id} ", "", 1)
+                for f in report.new_findings] + report.parse_errors
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(f"lint_metrics: {len(problems)} violation(s)", file=sys.stderr)
+        return 1
+    print(f"lint_metrics: OK ({rule.registered_count} registered metric "
+          f"names)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -423,9 +423,12 @@ class App:
                 self._grpc_server.register(spec, servicer)
             await self._grpc_server.start()
 
+        from gofr_tpu.aio import spawn_logged
         for topic, handler in self._subscriptions.items():
-            self._tasks.append(
-                asyncio.ensure_future(self._subscriber_loop(topic, handler)))
+            self._tasks.append(spawn_logged(
+                self._subscriber_loop(topic, handler), self.logger,
+                f"pubsub.subscriber.{topic}",
+                metrics=self.container.metrics))
 
         self.crontab.start()
         self.logger.info("app %s started (http=:%d metrics=:%d%s)",

@@ -214,6 +214,14 @@ class Container:
                               (0.001, 0.01, 0.1, 1, 10, 60, 300))
         metrics.new_counter("app_cron_runs_total",
                             "cron job runs by job name and result")
+        # async-task discipline (ISSUE 5 / graftcheck GT002): every
+        # fire-and-forget spawn goes through gofr_tpu.aio.spawn_logged,
+        # which counts tasks that died with an escaped exception here —
+        # a crashed subscriber/serve/cron loop becomes a dashboard line
+        metrics.new_counter(
+            "app_async_task_failures_total",
+            "background asyncio tasks that died with an escaped "
+            "exception, by task name")
 
     # -- outbound services (container.go:150-152) ---------------------------
     def add_http_service(self, name: str, service: Any) -> None:

@@ -95,11 +95,19 @@ class Context:
         batcher = getattr(self.container, "tpu_batcher", None)
         if batcher is not None:
             return await batcher.predict(model, example)
-        import jax
-        import numpy as np
-        batch = jax.tree.map(lambda l: np.asarray(l)[None], example)
-        result = self.container.tpu.predict(model, batch)
-        return jax.tree.map(lambda l: np.asarray(l)[0], result)
+        import asyncio
+
+        def _direct():
+            # whole fallback off-loop: executor.predict blocks on the
+            # device, and these asarray calls may sync device outputs
+            import jax
+            import numpy as np
+            batch = jax.tree.map(lambda l: np.asarray(l)[None], example)
+            result = self.container.tpu.predict(model, batch)
+            return jax.tree.map(lambda l: np.asarray(l)[0], result)
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, _direct)
 
     @property
     def file(self):

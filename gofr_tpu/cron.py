@@ -15,6 +15,7 @@ import asyncio
 import time
 from typing import Callable, Dict, List, Optional, Set
 
+from gofr_tpu.aio import spawn_logged
 from gofr_tpu.context import Context
 
 _FIELDS = (
@@ -119,7 +120,9 @@ class Crontab:
 
     def start(self) -> None:
         if self.jobs and self._task is None:
-            self._task = asyncio.ensure_future(self._tick_loop())
+            self._task = spawn_logged(
+                self._tick_loop(), self.container.logger, "cron.tick_loop",
+                metrics=self.container.metrics)
 
     def stop(self) -> None:
         if self._task is not None:
@@ -135,7 +138,13 @@ class Crontab:
                 last_minute = now.tm_min
                 for job in self.jobs:
                     if job.due(now):
-                        asyncio.ensure_future(self._run_job(job))
+                        # _run_job already isolates handler panics; the
+                        # spawn_logged callback catches bugs in the
+                        # isolation itself (span/metrics plumbing)
+                        spawn_logged(self._run_job(job),
+                                     self.container.logger,
+                                     f"cron.{job.name}",
+                                     metrics=self.container.metrics)
             await asyncio.sleep(60 - time.localtime().tm_sec + 0.05)
 
     async def _run_job(self, job: CronJob) -> None:

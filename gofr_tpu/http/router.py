@@ -9,6 +9,7 @@ pre-split exact-segment dict fast-path covers the hot endpoints.
 
 from __future__ import annotations
 
+import asyncio
 import mimetypes
 import os
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
@@ -119,10 +120,17 @@ class Router:
         return None
 
 
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
 def _make_file_handler(full_path: str) -> WireHandler:
     async def _serve(_req: Request):
         ctype = mimetypes.guess_type(full_path)[0] or "application/octet-stream"
-        with open(full_path, "rb") as fh:
-            content = fh.read()
+        # static payloads can be arbitrarily large: read off-loop so a
+        # multi-MB asset never stalls in-flight generations (GT001)
+        content = await asyncio.get_running_loop().run_in_executor(
+            None, _read_file, full_path)
         return 200, {"Content-Type": ctype}, content
     return _serve

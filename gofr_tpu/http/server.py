@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
+from gofr_tpu.aio import spawn_logged
 from gofr_tpu.http.request import Request
 from gofr_tpu.http.response import StreamBody
 
@@ -54,7 +55,11 @@ class _HTTPProtocol(asyncio.Protocol):
         self.transport = transport
         peer = transport.get_extra_info("peername")
         self.peername = f"{peer[0]}:{peer[1]}" if peer else ""
-        self.task = asyncio.ensure_future(self._serve_loop())
+        # _serve_loop answers protocol errors itself; the spawn_logged
+        # callback catches the loop *infrastructure* dying (a bug in the
+        # parser/writer), which would otherwise strand the connection
+        self.task = spawn_logged(self._serve_loop(), self.server.logger,
+                                 "http.serve_loop")
         self._data_event = asyncio.Event()
         self.server._connections.add(self)
 

@@ -12,6 +12,7 @@ install.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 
@@ -90,11 +91,18 @@ def make_openapi_handlers(spec_path: str):
     /.well-known routes. ``asset_handler`` serves the vendored swagger-ui
     dist under /.well-known/swagger/<asset>."""
 
+    def _read_spec() -> bytes:
+        with open(spec_path, "rb") as handle:
+            body = handle.read()
+        json.loads(body)  # refuse to serve a broken spec
+        return body
+
     async def spec_handler(request):
         try:
-            with open(spec_path, "rb") as handle:
-                body = handle.read()
-            json.loads(body)  # refuse to serve a broken spec
+            # spec read + parse off-loop: specs grow with the API surface
+            # and this handler shares the loop with serving (GT001)
+            body = await asyncio.get_running_loop().run_in_executor(
+                None, _read_spec)
         except Exception:
             return 500, {"Content-Type": "application/json"}, \
                 b'{"error":"openapi.json missing or invalid"}'
@@ -108,7 +116,14 @@ def make_openapi_handlers(spec_path: str):
 
     async def asset_handler(request):
         name = os.path.basename(request.path_params.get("asset", ""))
-        body = _load_assets().get(name) if name in _ASSET_TYPES else None
+        if name in _ASSET_TYPES:
+            # first hit reads ~1.6MB of vendored dist — off-loop; later
+            # hits return the cache without touching the filesystem
+            assets = await asyncio.get_running_loop().run_in_executor(
+                None, _load_assets)
+            body = assets.get(name)
+        else:
+            body = None
         if not body:
             return 404, {"Content-Type": "text/plain"}, b"not found"
         return 200, {"Content-Type": _ASSET_TYPES[name],

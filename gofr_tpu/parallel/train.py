@@ -50,10 +50,13 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
     batch_sharding = NamedSharding(
         mesh, P("dp", "sp") if has_sp else P("dp"))
 
+    # jit so moment tensors are created directly with param shardings;
+    # hoisted out of init_fn so repeated inits reuse one compiled program
+    jit_opt_init = jax.jit(optimizer.init)
+
     def init_fn(key: jax.Array) -> TrainState:
         params = shard_pytree(llama.init(cfg, key), mesh, param_specs)
-        # jit so moment tensors are created directly with param shardings
-        opt_state = jax.jit(optimizer.init)(params)
+        opt_state = jit_opt_init(params)
         return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
     loss = lambda p, t, y: llama.loss_fn(p, cfg, t, y,

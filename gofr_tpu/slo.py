@@ -301,7 +301,9 @@ class Watchdog:
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(self._run())
+            from gofr_tpu.aio import spawn_logged
+            self._task = spawn_logged(self._run(), self.logger,
+                                      "slo.watchdog", metrics=self.metrics)
 
     async def _run(self) -> None:
         while True:

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gofr_tpu.aio import spawn_logged
 from gofr_tpu.slo import DeadlineExceeded, current_deadline
 from gofr_tpu.trace import Span, current_span
 
@@ -113,9 +114,10 @@ class DynamicBatcher:
             if span is not None:
                 span.set_attribute("batch_size", len(pending.examples))
                 span.finish()
-        asyncio.ensure_future(self._run(name, pending.examples,
-                                        pending.futures, pending.spans,
-                                        pending.deadlines))
+        spawn_logged(self._run(name, pending.examples,
+                               pending.futures, pending.spans,
+                               pending.deadlines),
+                     self.logger, f"tpu.batch.{name}", metrics=self.metrics)
 
     def _shed_expired(self, name: str, examples: List[Any],
                       futures: List[asyncio.Future],
@@ -165,6 +167,8 @@ class DynamicBatcher:
                     step_span.add_link(span)
         try:
             import jax
+            # graftcheck: ignore[GT001] — examples are host payloads decoded
+            # from the wire; stacking them is pure-numpy, no device sync
             batch = jax.tree.map(
                 lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
                 *examples)
@@ -187,6 +191,8 @@ class DynamicBatcher:
             finished_at = time.monotonic()
             for i, future in enumerate(futures):
                 if not future.done():  # request may have timed out/gone
+                    # graftcheck: ignore[GT001] — fetch/predict returned
+                    # block_until_ready'd buffers; slicing is a host memcpy
                     future.set_result(
                         jax.tree.map(lambda l: np.asarray(l)[i], result))
                 if self.slo is not None:

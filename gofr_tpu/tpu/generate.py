@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from gofr_tpu.aio import spawn_logged
 from gofr_tpu.slo import DeadlineExceeded, current_deadline
 from gofr_tpu.tpu.compile_ledger import ShapeStats, suggest_ladder
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
@@ -686,7 +687,9 @@ class GenerationEngine:
     # -- public API ---------------------------------------------------------
     async def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.ensure_future(self._loop())
+            self._task = spawn_logged(self._loop(), self.logger,
+                                      "generate.engine_loop",
+                                      metrics=self.metrics)
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -1075,6 +1078,8 @@ class GenerationEngine:
         if p == 0:
             return 0, bucket, [], []
         nodes = chain[:p]
+        # graftcheck: ignore[GT001] — radix-store refcount pin (host dict
+        # bookkeeping), not a lock acquire; never blocks
         store.acquire(nodes)
         store.record_saved(p * store.page)
         return p, sb, [n.page_id for n in nodes], nodes

@@ -1,0 +1,14 @@
+#!/usr/bin/env python3
+"""graftcheck launcher — identical to ``python -m gofr_tpu.analysis``,
+for environments where the package is not on sys.path. All flags pass
+through; see docs/references/static-analysis.md for the rule catalog."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gofr_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

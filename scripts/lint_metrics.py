@@ -1,155 +1,21 @@
 #!/usr/bin/env python3
-"""Static metric-name lint.
+"""Metric-name lint — thin shim over graftcheck rule GT005.
 
-Walks ``gofr_tpu/`` ASTs and extracts the literal first argument of every
-metrics call — registrations (``new_counter``, ``new_updown_counter``,
-``new_histogram``, ``new_gauge``) and observations (``increment_counter``,
-``delta_updown_counter``, ``record_histogram``, ``set_gauge``) — then
-enforces:
-
-1. every name matches the OpenMetrics charset ``[a-zA-Z_][a-zA-Z0-9_]*``;
-2. every name carries the ``app_`` namespace prefix, except the
-   intentionally-unprefixed process runtime gauges in ``ALLOW_UNPREFIXED``;
-3. every observed name is registered somewhere in the tree, so a typo'd
-   observation (silently dropped at runtime by Manager's error-log-and-
-   continue policy) fails CI instead of producing a hole in a dashboard;
-4. every registered ``app_``-prefixed name appears in the metrics catalog
-   in ``docs/quick-start/observability.md`` — the docs-drift gate: adding
-   a metric without documenting it (or renaming one and orphaning its
-   catalog row) fails CI. ``--docs PATH`` points the check at an
-   alternate catalog file (used by the lint's own negative test).
-
-Exit code 0 = clean, 1 = violations (one per line on stderr).
-Run directly or via scripts/tier1.sh; tests/test_slo_observability.py and
-tests/test_compile_observability.py also invoke it so the lint itself
-stays under test.
+The lint logic moved into :mod:`gofr_tpu.analysis.rules.gt005_metrics`
+so it runs with the rest of the static-analysis suite
+(``python -m gofr_tpu.analysis``); this entry point is kept for existing
+callers and CI muscle memory. Flags and output are unchanged:
+``--docs PATH`` points at the metrics catalog to check for drift
+(default docs/quick-start/observability.md), exit 1 on any violation
+with one problem per line on stderr.
 """
 
-from __future__ import annotations
-
-import argparse
-import ast
 import pathlib
-import re
 import sys
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
-PACKAGE = ROOT / "gofr_tpu"
-DOCS_CATALOG = ROOT / "docs" / "quick-start" / "observability.md"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
-# any app_-namespaced token in the docs counts as "documented" — rows in
-# the catalog table, prose mentions, and code samples all qualify
-DOC_NAME_RE = re.compile(r"\bapp_[a-zA-Z0-9_]+\b")
-
-# process-runtime gauges predating the app_ namespace convention; kept
-# unprefixed for parity with common node-exporter dashboards
-ALLOW_UNPREFIXED = {
-    "threads_total",
-    "memory_rss_bytes",
-    "gc_objects",
-    "uptime_seconds",
-}
-
-REGISTER_METHODS = {
-    "new_counter",
-    "new_updown_counter",
-    "new_histogram",
-    "new_gauge",
-}
-OBSERVE_METHODS = {
-    "increment_counter",
-    "delta_updown_counter",
-    "record_histogram",
-    "set_gauge",
-}
-
-
-def _metric_calls(tree: ast.AST):
-    """Yield (method, name, lineno) for metrics calls with a literal
-    first argument. Non-literal names (dynamic dispatch) are skipped —
-    the lint is intentionally conservative."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if not isinstance(func, ast.Attribute):
-            continue
-        method = func.attr
-        if method not in REGISTER_METHODS | OBSERVE_METHODS:
-            continue
-        if not node.args:
-            continue
-        first = node.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            yield method, first.value, node.lineno
-
-
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument(
-        "--docs", type=pathlib.Path, default=DOCS_CATALOG,
-        help="metrics catalog to check app_ names against "
-             "(default: docs/quick-start/observability.md)")
-    opts = parser.parse_args(argv)
-
-    registered = set()
-    observed = []  # (path, lineno, name)
-    problems = []
-
-    for path in sorted(PACKAGE.rglob("*.py")):
-        try:
-            tree = ast.parse(path.read_text(encoding="utf-8"))
-        except SyntaxError as exc:
-            problems.append(f"{path}: unparseable: {exc}")
-            continue
-        rel = path.relative_to(ROOT)
-        for method, name, lineno in _metric_calls(tree):
-            if not NAME_RE.match(name):
-                problems.append(
-                    f"{rel}:{lineno}: metric {name!r} violates the "
-                    f"OpenMetrics charset [a-zA-Z_][a-zA-Z0-9_]*")
-            if (not name.startswith("app_")
-                    and name not in ALLOW_UNPREFIXED):
-                problems.append(
-                    f"{rel}:{lineno}: metric {name!r} missing the app_ "
-                    f"namespace prefix (or add it to ALLOW_UNPREFIXED)")
-            if method in REGISTER_METHODS:
-                registered.add(name)
-            else:
-                observed.append((rel, lineno, name))
-
-    for rel, lineno, name in observed:
-        if name not in registered:
-            problems.append(
-                f"{rel}:{lineno}: metric {name!r} is observed but never "
-                f"registered — Manager drops it at runtime")
-
-    # docs-drift gate: every registered app_ metric must be documented
-    try:
-        documented = set(
-            DOC_NAME_RE.findall(opts.docs.read_text(encoding="utf-8")))
-    except OSError as exc:
-        problems.append(f"{opts.docs}: unreadable metrics catalog: {exc}")
-        documented = None
-    if documented is not None:
-        docs_rel = (opts.docs.relative_to(ROOT)
-                    if opts.docs.is_relative_to(ROOT) else opts.docs)
-        for name in sorted(registered):
-            if name.startswith("app_") and name not in documented:
-                problems.append(
-                    f"{docs_rel}: metric {name!r} is registered in source "
-                    f"but missing from the metrics catalog — document it "
-                    f"(or remove the registration)")
-
-    for problem in problems:
-        print(problem, file=sys.stderr)
-    if problems:
-        print(f"lint_metrics: {len(problems)} violation(s)", file=sys.stderr)
-        return 1
-    print(f"lint_metrics: OK ({len(registered)} registered metric names)")
-    return 0
-
+from gofr_tpu.analysis.rules.gt005_metrics import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -6,10 +6,12 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
-# static metric-name lint (app_ prefix + OpenMetrics charset + docs-drift
-# check against the observability.md catalog) runs before the test sweep
-# so a bad or undocumented metric name fails fast
-python scripts/lint_metrics.py || exit 1
+# graftcheck static analysis (event-loop hygiene, task discipline,
+# recompile hazards, traced side effects, metric naming + docs-drift)
+# runs before the test sweep so a new finding fails fast with its rule
+# ID and file:line; grandfathered findings live in the committed
+# baseline (scripts/graftcheck_baseline.json)
+env JAX_PLATFORMS=cpu python -m gofr_tpu.analysis || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

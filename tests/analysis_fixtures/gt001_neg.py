@@ -1,0 +1,37 @@
+"""GT001 negative fixture: async code that offloads blocking work.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import asyncio
+
+
+def blocking_read(path):
+    # sync I/O is fine here: this function is only ever *passed* to an
+    # executor, so it has no call edge from the loop
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+async def handler(path):
+    loop = asyncio.get_running_loop()
+    data = await loop.run_in_executor(None, blocking_read, path)
+    await asyncio.sleep(0.01)
+    return data
+
+
+async def hopped(path):
+    return await asyncio.to_thread(blocking_read, path)
+
+
+async def locked(lock):
+    await lock.acquire()
+    try:
+        return 1
+    finally:
+        lock.release()
+
+
+async def async_with_lock(lock):
+    async with lock:
+        return 2

@@ -1,0 +1,40 @@
+"""GT001 positive fixture: blocking calls reachable from async defs.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import time
+
+import numpy as np
+
+
+async def handler(values):
+    time.sleep(0.1)
+    return np.asarray(values)
+
+
+def _helper(result):
+    return result.block_until_ready()
+
+
+async def transitive(x):
+    # blocks through a plain-call hop: handler -> _helper -> device sync
+    return _helper(x)
+
+
+async def lock_wait(lock):
+    lock.acquire()
+    try:
+        return 1
+    finally:
+        lock.release()
+
+
+async def reads(path):
+    with open(path) as fh:
+        return fh.read()
+
+
+async def scheduler(loop, x):
+    # loop-scheduled callbacks run on the loop: edge to _helper
+    loop.call_soon(_helper, x)

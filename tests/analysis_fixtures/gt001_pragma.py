@@ -1,0 +1,16 @@
+"""Pragma fixture: a real GT001 violation, deliberately suppressed.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import time
+
+
+async def handler():
+    # graftcheck: ignore[GT001] — fixture: deliberate suppression with a
+    # justification comment, the required form for host-side exceptions
+    time.sleep(0.1)
+
+
+async def inline_pragma():
+    time.sleep(0.2)  # graftcheck: ignore[GT001] — fixture: same-line form

@@ -1,0 +1,36 @@
+"""GT002 negative fixture: every spawn's outcome is observed.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import asyncio
+
+from gofr_tpu.aio import spawn_logged
+
+
+async def worker():
+    return 1
+
+
+async def awaited_inline():
+    return await asyncio.create_task(worker())
+
+
+def returned():
+    return asyncio.ensure_future(worker())
+
+
+async def callback_attached():
+    task = asyncio.create_task(worker())
+    task.add_done_callback(lambda done: done.exception())
+    return task
+
+
+async def awaited_later():
+    task = asyncio.ensure_future(worker())
+    await asyncio.sleep(0)
+    await task
+
+
+def via_spawn_logged(logger, metrics):
+    return spawn_logged(worker(), logger, "fixture.worker", metrics=metrics)

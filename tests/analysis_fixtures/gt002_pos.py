@@ -1,0 +1,25 @@
+"""GT002 positive fixture: fire-and-forget task spawns.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import asyncio
+
+
+async def worker():
+    return 1
+
+
+def dropped():
+    asyncio.ensure_future(worker())
+
+
+def passed_along(tasks):
+    tasks.append(asyncio.create_task(worker()))
+
+
+class Engine:
+    def start(self):
+        # stored but never awaited / given a done-callback in this scope;
+        # "stop() awaits it later" still loses every exception in between
+        self._task = asyncio.create_task(worker())

@@ -1,0 +1,38 @@
+"""GT003 negative fixture: disciplined jit call sites.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_BUCKETS = (8, 16, 32)
+
+
+def _forward(params, tokens):
+    return params, tokens
+
+
+static_jitted = jax.jit(_forward, static_argnums=(1,))
+plain_jitted = jax.jit(_forward)
+
+
+def cached_factory(cache, key):
+    # the repo's jit-factory idiom: build once, reuse from a dict —
+    # the jit call is not immediately invoked, so no fresh-jit hazard
+    fn = cache.get(key)
+    if fn is None:
+        fn = jax.jit(_forward)
+        cache[key] = fn
+    return fn
+
+
+def bucketed(params, tokens):
+    # static arg is a hashable rung, and the device shape is a rung too
+    rung = next(b for b in _BUCKETS if b >= len(tokens))
+    padded = jnp.zeros((rung, 4))
+    return static_jitted(params, rung), padded
+
+
+def tuple_static(params):
+    return static_jitted(params, (1, 2, 3))

@@ -1,0 +1,35 @@
+"""GT003 positive fixture: recompile hazards at jit call sites.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _forward(params, tokens):
+    return params, tokens
+
+
+static_jitted = jax.jit(_forward, static_argnums=(1,))
+plain_jitted = jax.jit(_forward)
+
+
+def per_call(params, tokens):
+    # fresh-jit: new wrapper + compile-cache entry on every invocation
+    return jax.jit(_forward)(params, tokens)
+
+
+def unhashable(params):
+    # list literal at a static position
+    return static_jitted(params, [1, 2, 3])
+
+
+def shape_flow(params, tokens):
+    # len() into a non-static position: traced scalar, can't shape anything
+    return plain_jitted(params, len(tokens))
+
+
+def raw_alloc(batch):
+    # unbucketed device shape: one executable per distinct request size
+    return jnp.zeros((len(batch), 128))

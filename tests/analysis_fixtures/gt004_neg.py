@@ -1,0 +1,39 @@
+"""GT004 negative fixture: trace-safe effects and static-only branches.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def debug_printed(x):
+    jax.debug.print("x = {}", x)
+    return x * 2
+
+
+@jax.jit
+def structural(x):
+    if x is None:
+        return jnp.zeros((4,))
+    if x.ndim == 2:
+        return x.sum(axis=-1)
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_branch(x, mode):
+    if mode == "fast":
+        return x
+    return x * 2
+
+
+def host_side(logger, x):
+    # not a traced body: loggers and branches are fine out here
+    logger.info("dispatching %s", x)
+    if x:
+        return 1
+    return 0

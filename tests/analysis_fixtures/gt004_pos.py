@@ -1,0 +1,49 @@
+"""GT004 positive fixture: side effects and tracer branches in traced
+bodies.
+
+Parsed by graftcheck in tests, never imported (``logger`` / ``metrics``
+are deliberately undefined).
+"""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def noisy(x):
+    print("tracing", x)
+    return x * 2
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def branchy(x, flag):
+    if x > 0:
+        return x
+    return -x
+
+
+def _logged_step(x):
+    logger.info("step %s", x)  # noqa: F821 — parse-only fixture
+    return x
+
+
+logged_step = jax.jit(_logged_step)
+
+
+def _metered_step(x):
+    metrics.increment_counter("app_fixture_steps_total")  # noqa: F821
+    return x
+
+
+metered_step = jax.jit(_metered_step)
+
+
+@jax.jit
+def scanned(xs):
+    def one(carry, x):
+        # nested scan-step param carries a tracer from the outer trace
+        if x:
+            carry = carry + x
+        return carry, x
+    return jax.lax.scan(one, 0, xs)

@@ -1,0 +1,14 @@
+"""GT005 negative fixture: disciplined metric names.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+
+def register(metrics):
+    metrics.new_counter("app_fixture_requests_total", "documented + used")
+    metrics.new_gauge("uptime_seconds", "intentionally unprefixed runtime "
+                                        "gauge (ALLOW_UNPREFIXED)")
+
+
+def observe(metrics):
+    metrics.increment_counter("app_fixture_requests_total")

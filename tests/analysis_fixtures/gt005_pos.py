@@ -1,0 +1,15 @@
+"""GT005 positive fixture: metric-naming violations.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+
+def register(metrics):
+    metrics.new_counter("bad-charset-name", "hyphens break OpenMetrics")
+    metrics.new_counter("unprefixed_total", "missing the app_ namespace")
+    metrics.new_counter("app_fixture_undocumented_total",
+                        "registered but absent from gt005_docs.md")
+
+
+def observe(metrics):
+    metrics.increment_counter("app_fixture_never_registered_total")

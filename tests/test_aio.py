@@ -1,0 +1,97 @@
+"""spawn_logged: background-task failures are logged and counted."""
+
+import asyncio
+
+import pytest
+
+from gofr_tpu.aio import spawn_logged
+
+
+class _Logger:
+    def __init__(self):
+        self.errors = []
+
+    def error(self, message, *args, **fields):
+        self.errors.append(message % args if args else message)
+
+
+class _Metrics:
+    def __init__(self):
+        self.counts = []
+
+    def increment_counter(self, name, **labels):
+        self.counts.append((name, labels))
+
+
+async def _settle():
+    # done-callbacks run via loop.call_soon after the task completes
+    for _ in range(3):
+        await asyncio.sleep(0)
+
+
+def test_spawn_logged_failure_is_logged_and_counted():
+    logger, metrics = _Logger(), _Metrics()
+
+    async def boom():
+        raise RuntimeError("kaput")
+
+    async def main():
+        task = spawn_logged(boom(), logger, "fixture.boom", metrics=metrics)
+        await asyncio.gather(task, return_exceptions=True)
+        await _settle()
+        return task
+
+    task = asyncio.run(main())
+    assert task.get_name() == "fixture.boom"
+    assert logger.errors == [
+        "background task fixture.boom died: RuntimeError('kaput')"]
+    assert metrics.counts == [
+        ("app_async_task_failures_total", {"task": "fixture.boom"})]
+
+
+def test_spawn_logged_success_is_silent():
+    logger, metrics = _Logger(), _Metrics()
+
+    async def fine():
+        return 42
+
+    async def main():
+        task = spawn_logged(fine(), logger, "fixture.fine", metrics=metrics)
+        result = await task
+        await _settle()
+        return result
+
+    assert asyncio.run(main()) == 42
+    assert logger.errors == [] and metrics.counts == []
+
+
+def test_spawn_logged_cancellation_is_not_a_failure():
+    logger, metrics = _Logger(), _Metrics()
+
+    async def forever():
+        await asyncio.Event().wait()
+
+    async def main():
+        task = spawn_logged(forever(), logger, "fixture.forever",
+                            metrics=metrics)
+        await asyncio.sleep(0)
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        await _settle()
+
+    asyncio.run(main())
+    assert logger.errors == [] and metrics.counts == []
+
+
+def test_spawn_logged_works_without_logger_or_metrics():
+    async def boom():
+        raise ValueError("unobserved but not fatal")
+
+    async def main():
+        task = spawn_logged(boom())
+        await asyncio.gather(task, return_exceptions=True)
+        await _settle()
+        return task
+
+    task = asyncio.run(main())
+    assert isinstance(task.exception(), ValueError)

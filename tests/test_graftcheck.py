@@ -1,0 +1,284 @@
+"""graftcheck static-analysis suite tests.
+
+Per-rule fixture assertions (one positive + one negative snippet per
+rule under ``tests/analysis_fixtures/``), pragma suppression, baseline
+mechanics, the self-clean invariant on ``gofr_tpu/``, and the CLI
+contract (exit 0 on the repo; exit 1 with rule ID + file:line on a
+seeded violation).
+"""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+from gofr_tpu.analysis import engine
+from gofr_tpu.analysis.rules import ALL_RULES, default_rules
+from gofr_tpu.analysis.rules.gt005_metrics import MetricDisciplineRule
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "analysis_fixtures"
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURE_DOCS = FIXTURES / "gt005_docs.md"
+
+
+def scan(filename, rule_id, **options):
+    rules = default_rules(select=[rule_id], **options)
+    return engine.run(paths=[FIXTURES / filename], rules=rules, baseline={})
+
+
+def keys(report):
+    return [f.key for f in report.new_findings]
+
+
+# -- GT001 event-loop-block --------------------------------------------------
+
+def test_gt001_positive_flags_blocking_calls():
+    report = scan("gt001_pos.py", "GT001")
+    got = keys(report)
+    assert "time.sleep(...) in handler" in got
+    assert "numpy.asarray(...) in handler" in got
+    # transitive: async transitive() -> _helper() -> device sync
+    assert ".block_until_ready() in _helper" in got
+    assert ".acquire() in lock_wait" in got
+    assert "open(...) in reads" in got
+    for finding in report.new_findings:
+        assert finding.rule == "GT001"
+        rendered = finding.render()
+        assert "gt001_pos.py" in rendered and "GT001" in rendered
+
+
+def test_gt001_transitive_chain_names_async_root():
+    report = scan("gt001_pos.py", "GT001")
+    chained = [f for f in report.new_findings
+               if f.key == ".block_until_ready() in _helper"]
+    assert chained and "via _helper" in chained[0].message
+
+
+def test_gt001_negative_offloaded_code_is_clean():
+    report = scan("gt001_neg.py", "GT001")
+    assert report.new_findings == []
+    assert report.exit_code == 0
+
+
+def test_gt001_pragma_suppresses_with_justification():
+    report = scan("gt001_pragma.py", "GT001")
+    assert report.new_findings == []
+    assert report.suppressed == 2  # comment-block form + same-line form
+
+
+# -- GT002 fire-and-forget tasks ---------------------------------------------
+
+def test_gt002_positive_flags_unobserved_spawns():
+    report = scan("gt002_pos.py", "GT002")
+    got = keys(report)
+    assert "asyncio.ensure_future(worker) in dropped" in got
+    assert "asyncio.create_task(worker) in passed_along" in got
+    assert "asyncio.create_task(worker) in start" in got
+    assert all(f.rule == "GT002" for f in report.new_findings)
+
+
+def test_gt002_negative_observed_spawns_are_clean():
+    report = scan("gt002_neg.py", "GT002")
+    assert report.new_findings == []
+
+
+# -- GT003 recompile hazards -------------------------------------------------
+
+def test_gt003_positive_flags_all_four_hazards():
+    report = scan("gt003_pos.py", "GT003")
+    got = keys(report)
+    assert "fresh-jit in per_call" in got
+    assert "unhashable-static arg1 of static_jitted" in got
+    assert "shape-arg arg1 of plain_jitted" in got
+    assert "raw-shape in raw_alloc" in got
+
+
+def test_gt003_shape_arg_is_a_warning():
+    report = scan("gt003_pos.py", "GT003")
+    by_key = {f.key: f for f in report.new_findings}
+    assert by_key["shape-arg arg1 of plain_jitted"].severity == "warning"
+    assert by_key["fresh-jit in per_call"].severity == "error"
+
+
+def test_gt003_negative_cached_and_bucketed_is_clean():
+    report = scan("gt003_neg.py", "GT003")
+    assert report.new_findings == []
+
+
+# -- GT004 traced side effects -----------------------------------------------
+
+def test_gt004_positive_flags_effects_and_tracer_branches():
+    report = scan("gt004_pos.py", "GT004")
+    got = keys(report)
+    assert "print(...) in noisy" in got
+    assert "if x in branchy" in got           # x traced; flag is static
+    assert "logger.info(...) in _logged_step" in got
+    assert ".increment_counter(...) in _metered_step" in got
+    assert "if x in scanned" in got           # nested lax.scan step param
+
+
+def test_gt004_negative_safe_patterns_are_clean():
+    report = scan("gt004_neg.py", "GT004")
+    assert report.new_findings == []
+
+
+# -- GT005 metric discipline -------------------------------------------------
+
+def test_gt005_positive_flags_all_four_checks():
+    report = scan("gt005_pos.py", "GT005", docs_catalog=FIXTURE_DOCS)
+    got = keys(report)
+    assert "charset bad-charset-name" in got
+    assert "prefix unprefixed_total" in got
+    assert "unregistered app_fixture_never_registered_total" in got
+    assert "undocumented app_fixture_undocumented_total" in got
+
+
+def test_gt005_negative_documented_and_registered_is_clean():
+    report = scan("gt005_neg.py", "GT005", docs_catalog=FIXTURE_DOCS)
+    assert report.new_findings == []
+
+
+# -- engine mechanics --------------------------------------------------------
+
+def _write_module(tmp_path, body):
+    path = tmp_path / "seeded.py"
+    path.write_text(textwrap.dedent(body), encoding="utf-8")
+    return path
+
+
+def test_file_level_pragma_suppresses_whole_file(tmp_path):
+    path = _write_module(tmp_path, """\
+        # graftcheck: ignore-file[GT001]
+        import time
+
+        async def handler():
+            time.sleep(1)
+    """)
+    report = engine.run(paths=[path],
+                        rules=default_rules(select=["GT001"]), baseline={})
+    assert report.new_findings == [] and report.suppressed == 1
+
+
+def test_baseline_pins_by_fingerprint_count(tmp_path):
+    path = _write_module(tmp_path, """\
+        import time
+
+        async def handler():
+            time.sleep(1)
+            time.sleep(2)
+    """)
+    rules = default_rules(select=["GT001"])
+    free = engine.run(paths=[path], rules=rules, baseline={})
+    assert len(free.new_findings) == 2
+    fingerprint = free.new_findings[0].fingerprint
+    assert free.new_findings[1].fingerprint == fingerprint  # same site key
+
+    partial = engine.run(paths=[path],
+                         rules=default_rules(select=["GT001"]),
+                         baseline={fingerprint: 1})
+    assert len(partial.new_findings) == 1    # one grandfathered, one new
+    assert len(partial.baselined) == 1
+
+    full = engine.run(paths=[path], rules=default_rules(select=["GT001"]),
+                      baseline={fingerprint: 2})
+    assert full.new_findings == [] and full.exit_code == 0
+
+    stale = engine.run(paths=[path], rules=default_rules(select=["GT001"]),
+                       baseline={fingerprint: 3})
+    assert stale.stale_baseline == [fingerprint]
+
+
+def test_baseline_roundtrip(tmp_path):
+    path = _write_module(tmp_path, """\
+        import time
+
+        async def handler():
+            time.sleep(1)
+    """)
+    report = engine.run(paths=[path],
+                        rules=default_rules(select=["GT001"]), baseline={})
+    baseline_path = tmp_path / "baseline.json"
+    engine.write_baseline(baseline_path, report.new_findings)
+    counts = engine.load_baseline(baseline_path)
+    assert counts == {report.new_findings[0].fingerprint: 1}
+    pinned = engine.run(paths=[path],
+                        rules=default_rules(select=["GT001"]),
+                        baseline=counts)
+    assert pinned.new_findings == [] and len(pinned.baselined) == 1
+
+
+def test_unparseable_file_fails(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def oops(:\n", encoding="utf-8")
+    report = engine.run(paths=[bad],
+                        rules=default_rules(select=["GT001"]), baseline={})
+    assert report.parse_errors and report.exit_code == 1
+
+
+# -- self-clean + CLI contract -----------------------------------------------
+
+def test_repo_scans_clean_without_baseline():
+    """The shipped tree has zero unsuppressed findings — the committed
+    baseline stays empty, so any new finding fails tier1 immediately."""
+    report = engine.run(paths=[engine.PACKAGE], rules=default_rules(),
+                        baseline={})
+    assert [f.render() for f in report.new_findings] == []
+    assert report.parse_errors == []
+
+
+def test_committed_baseline_is_empty():
+    assert engine.load_baseline(engine.DEFAULT_BASELINE) == {}
+
+
+def test_cli_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "gofr_tpu.analysis"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftcheck: OK" in proc.stdout
+
+
+def test_cli_fails_on_seeded_violation(tmp_path):
+    seeded = _write_module(tmp_path, """\
+        import time
+
+        async def handler():
+            time.sleep(1)
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-m", "gofr_tpu.analysis", str(tmp_path),
+         "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "GT001" in proc.stderr
+    assert f"{seeded}:4:" in proc.stderr  # file:line of the violation
+
+
+def test_cli_list_rules_covers_catalog():
+    proc = subprocess.run(
+        [sys.executable, "-m", "gofr_tpu.analysis", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0
+    for cls in ALL_RULES:
+        assert cls.rule_id in proc.stdout
+    assert {cls.rule_id for cls in ALL_RULES} == \
+        {"GT001", "GT002", "GT003", "GT004", "GT005"}
+
+
+def test_lint_metrics_shim_still_works():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_metrics.py")],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_lint_metrics_shim_docs_drift(tmp_path):
+    empty_docs = tmp_path / "docs.md"
+    empty_docs.write_text("no metrics documented here\n", encoding="utf-8")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_metrics.py"),
+         "--docs", str(empty_docs)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "missing from the metrics catalog" in proc.stderr
