@@ -71,6 +71,14 @@ def build_app():
         prefix_cache=os.environ.get("GENERATE_PREFIX_CACHE") == "1",
         prefix_cache_bytes=int(os.environ.get(
             "GENERATE_PREFIX_CACHE_BYTES", str(64 << 20))),
+        # unified paged KV: one page pool shared by prefill output, the
+        # prefix cache and decode — HBM priced at the live token mix
+        # instead of max_slots*max_len, prefix hits admit with zero KV
+        # copies (docs/tpu/model-serving.md "Unified paged KV")
+        paged_kv=os.environ.get("GENERATE_PAGED_KV") == "1",
+        kv_page=int(os.environ.get("GENERATE_KV_PAGE", "32")),
+        kv_pool_bytes=(int(os.environ["GENERATE_KV_POOL_BYTES"])
+                       if "GENERATE_KV_POOL_BYTES" in os.environ else None),
         logger=app.logger, metrics=app.container.metrics,
         # flight recorder: queue.wait/prefill/decode child spans per
         # request, engine-step spans with links, /debug/statusz timelines

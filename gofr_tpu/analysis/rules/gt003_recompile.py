@@ -27,6 +27,14 @@ Checks:
 - **raw-len device shape** (``hazard=raw-shape``): ``jnp.zeros``-family
   constructors whose shape contains a bare ``len(...)`` — an unbucketed
   dimension mints one executable per distinct request size.
+- **live-count slice width** (``hazard=page-width``): a device upload
+  (``jnp.asarray``/``jnp.array``/``jax.device_put``) or a known-jitted
+  call whose argument is sliced to a ``len(...)``/``.shape``-derived
+  bound (``table[:, :len(pages)]``). The slice width becomes an array
+  dimension, so a *live count* — pages held, slots active — mints one
+  executable per distinct value. Slice to a declared ladder rung
+  instead (the page-gather-width idiom in
+  ``GenerationEngine._table_dev``).
 
 Known-jitted callables are resolved module-locally: names bound to
 ``jax.jit(...)`` and functions decorated with ``@jax.jit`` /
@@ -86,6 +94,27 @@ def _shape_derived(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _sliced_by_len(node: ast.AST) -> Optional[str]:
+    """A Subscript anywhere in ``node`` whose slice *bounds* are
+    len()/.shape-derived — ``x[:, :len(pages)]`` — i.e. a live count
+    becoming an array dimension."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Subscript):
+            continue
+        parts = sub.slice.elts if isinstance(sub.slice, ast.Tuple) \
+            else [sub.slice]
+        for part in parts:
+            if not isinstance(part, ast.Slice):
+                continue
+            for bound in (part.lower, part.upper, part.step):
+                if bound is None:
+                    continue
+                src = _shape_derived(bound)
+                if src is not None:
+                    return src
+    return None
+
+
 class RecompileHazardRule(Rule):
     rule_id = "GT003"
     title = "recompile-hazard"
@@ -120,6 +149,7 @@ class RecompileHazardRule(Rule):
             findings.extend(self._fresh_jit(module, node))
             findings.extend(self._jitted_call(module, node, jitted))
             findings.extend(self._raw_shape(module, node))
+            findings.extend(self._page_width(module, node))
         return findings
 
     def _fresh_jit(self, module: ModuleInfo,
@@ -168,6 +198,23 @@ class RecompileHazardRule(Rule):
                     severity=self.severity,
                     key=f"unhashable-static arg{index} of {name}",
                 ))
+            width_src = None if is_static else _sliced_by_len(arg)
+            if width_src is not None:
+                findings.append(Finding(
+                    rule=self.rule_id,
+                    path=module.relpath,
+                    line=arg.lineno,
+                    message=(
+                        f"recompile hazard [page-width]: argument {index} "
+                        f"of jitted '{name}' is sliced to a "
+                        f"{width_src}-derived width — the live count "
+                        f"becomes an array dimension, one executable per "
+                        f"distinct value; slice to a declared ladder "
+                        f"rung instead"),
+                    severity=self.severity,
+                    key=f"page-width arg{index} of {name}",
+                ))
+                continue   # the precise finding; skip the generic one
             shape_src = None if is_static else _shape_derived(arg)
             if shape_src is not None:
                 findings.append(Finding(
@@ -231,4 +278,34 @@ class RecompileHazardRule(Rule):
                     severity=self.severity,
                     key=f"raw-shape in {where}",
                 ),)
+        return ()
+
+    def _page_width(self, module: ModuleInfo,
+                    call: ast.Call) -> Iterable[Finding]:
+        """Device uploads sliced to a live-count width: the host->device
+        copy's shape tracks ``len(pages)``-style state, so every distinct
+        count both re-uploads and re-specializes whatever consumes it."""
+        dotted = module.dotted(call.func)
+        if dotted not in ("jnp.asarray", "jax.numpy.asarray", "jnp.array",
+                          "jax.numpy.array", "jax.device_put"):
+            return ()
+        for arg in call.args:
+            src = _sliced_by_len(arg)
+            if src is None:
+                continue
+            fn = module.enclosing_function(call)
+            where = fn.name if fn is not None else "<module>"
+            return (Finding(
+                rule=self.rule_id,
+                path=module.relpath,
+                line=call.lineno,
+                message=(
+                    f"recompile hazard [page-width]: device upload in "
+                    f"'{where}' is sliced to a {src}-derived width — a "
+                    f"live page/item count becomes an array dimension, "
+                    f"minting one executable per distinct value; slice "
+                    f"to a declared ladder rung instead"),
+                severity=self.severity,
+                key=f"page-width in {where}",
+            ),)
         return ()

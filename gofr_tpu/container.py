@@ -208,6 +208,25 @@ class Container:
         metrics.new_gauge(
             "app_tpu_prefix_cache_occupancy",
             "prefix-KV page pool: used pages / total pages")
+        # unified paged KV catalog (ISSUE 6): one page pool backs prefill
+        # output, the prefix cache, and decode — pool pressure and the
+        # raggedness of what slots actually hold
+        metrics.new_gauge("app_tpu_kv_pages_used",
+                          "KV page pool: pages currently referenced")
+        metrics.new_gauge("app_tpu_kv_pages_capacity",
+                          "KV page pool: total pages in the pool")
+        metrics.new_updown_counter(
+            "app_tpu_kv_pages_written_total",
+            "pool pages written by prefill/publish scatters — a prefix "
+            "hit admits with zero new writes")
+        metrics.new_counter(
+            "app_tpu_kv_pages_stalled_total",
+            "page allocations that failed after reclaim (admission "
+            "backpressure / decode-growth stalls)")
+        metrics.new_gauge(
+            "app_tpu_kv_ragged_fill_ratio",
+            "live tokens / (pages held x page size) across decoding "
+            "slots — how ragged the paged KV actually is")
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",

@@ -32,6 +32,7 @@ from gofr_tpu.ops import (
     apply_rope,
     decode_attention,
     decode_attention_cached,
+    gather_kv_pages,
     prefill_attention,
     prefix_prefill_attention,
     rms_norm,
@@ -390,6 +391,94 @@ def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
     x = rms_norm(x[:, 0], params["out_norm"], cfg.norm_eps)
     logits = qmm(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_cache, cache_len + 1
+
+
+def decode_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
+                      token: jnp.ndarray, pool: Dict[str, jnp.ndarray],
+                      page_table: jnp.ndarray, cache_len: jnp.ndarray,
+                      active: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray],
+                                 jnp.ndarray]:
+    """One decode step over the unified paged KV pool (ISSUE 6).
+
+    token (B,) int32; ``pool`` holds the shared page-pool leaves
+    (L, num_pages, page, Hkv, Dh) (+ int8 scale planes); ``page_table``
+    (B, P) int32 maps each slot's sequence pages to pool rows, with
+    ``num_pages`` as the unallocated sentinel — P is a *static* ladder
+    rung, so one executable serves every fill level just like the dense
+    cache, and P plays the attention-window role (only the table's pages
+    are gathered/streamed, not a max_len tail). ``active`` (B,) bool
+    gates the append: the pool is shared, so an inactive slot must not
+    scatter — its row could have been freed and reallocated to another
+    stream while a pipelined tick was in flight — hence its destination
+    is routed to the sentinel page and dropped (the dense path could
+    ignore this: each slot owned its cache row forever).
+
+    Per layer this gathers the table's pages into the dense-cache-shaped
+    (B, P*page, Hkv, Dh) view and runs exactly the dense decode-step
+    attention over it (ops.paged_decode_attention formulation), then
+    appends the new K/V row at page ``cache_len // page``, offset
+    ``cache_len % page``. Pool leaves ride the scan carry for the same
+    reason the dense cache does (no stacked-ys rewrite). Returns
+    (logits (B, V), pool, cache_len + 1) — the caller freezes inactive
+    rows' cache_len, as on the dense path.
+    """
+    b = token.shape[0]
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    positions = cache_len[:, None]                       # (B, 1)
+    x = params["tok_emb"][token][:, None, :]             # (B, 1, D)
+    int8 = cfg.kv_int8
+    carry_keys = ("k", "v", "ks", "vs") if int8 else ("k", "v")
+    num_pages = pool["k"].shape[1]
+    page = pool["k"].shape[2]
+    # the append destination is the same for every layer: hoist it
+    page_col = cache_len // page                         # (B,)
+    page_row = jnp.take_along_axis(page_table, page_col[:, None],
+                                   axis=1, mode="clip")[:, 0]
+    dest_row = jnp.where(active, page_row, num_pages)    # sentinel-drop
+    offset = cache_len % page
+
+    def body(carry, layer_and_idx):
+        x = carry[0]
+        pools = carry[1:]
+        layer, idx = layer_and_idx
+        planes = [lax.dynamic_index_in_dim(c, idx, 0, keepdims=False)
+                  for c in pools]                        # (N, page, ...)
+        views = [gather_kv_pages(p, page_table) for p in planes]
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
+        if cfg.use_flash_decode and not int8:
+            from gofr_tpu.ops.pallas import flash_decode_attention
+            attn = flash_decode_attention(q, views[0], views[1], k[:, 0],
+                                          v[:, 0], cache_len)
+        else:
+            k_scale = views[2] if int8 else None
+            v_scale = views[3] if int8 else None
+            attn = decode_attention_cached(q, views[0], views[1], k[:, 0],
+                                           v[:, 0], cache_len,
+                                           k_scale=k_scale, v_scale=v_scale)
+        x = x + qmm(attn.reshape(b, 1, -1), layer["wo"])
+        h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+        x = x + _ffn(layer, h)
+        if int8:
+            kq, ks = quantize_kv(k[:, 0])
+            vq, vs = quantize_kv(v[:, 0])
+            new_rows = (kq, vq, ks, vs)
+        else:
+            new_rows = (k[:, 0], v[:, 0])
+        pools = tuple(
+            c.at[idx, dest_row, offset].set(row, mode="drop")
+            for c, row in zip(pools, new_rows))
+        return (x,) + pools, None
+
+    carry, _ = lax.scan(
+        body, (x,) + tuple(pool[key] for key in carry_keys),
+        (params["layers"], jnp.arange(cfg.n_layers)))
+    x = carry[0]
+    new_pool = dict(zip(carry_keys, carry[1:]))
+    x = rms_norm(x[:, 0], params["out_norm"], cfg.norm_eps)
+    logits = qmm(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_pool, cache_len + 1
 
 
 def generate(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,

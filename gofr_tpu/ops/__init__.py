@@ -10,6 +10,8 @@ from gofr_tpu.ops.attention import (
     causal_mask,
     decode_attention,
     decode_attention_cached,
+    gather_kv_pages,
+    paged_decode_attention,
     prefill_attention,
     prefix_prefill_attention,
 )
@@ -18,6 +20,6 @@ from gofr_tpu.ops.rotary import apply_rope, rope_table
 
 __all__ = [
     "attention", "causal_mask", "decode_attention", "prefill_attention",
-    "prefix_prefill_attention",
+    "prefix_prefill_attention", "gather_kv_pages", "paged_decode_attention",
     "layer_norm", "rms_norm", "apply_rope", "rope_table",
 ]

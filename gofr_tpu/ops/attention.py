@@ -85,6 +85,64 @@ def decode_attention(q, k_cache, v_cache, cache_len) -> jnp.ndarray:
     return attention(q, k_cache, v_cache, mask)
 
 
+def gather_kv_pages(pages: jnp.ndarray,
+                    page_table: jnp.ndarray) -> jnp.ndarray:
+    """Gather a per-slot contiguous KV view out of a shared page pool.
+
+    pages: (num_pages, page, ...) — one KV-cache leaf of the unified
+    page pool (tpu/page_pool), layer axis already indexed out.
+    page_table: (B, P) int32 — page ids per slot in sequence order;
+    entries == num_pages are the unallocated sentinel. Returns
+    (B, P * page, ...): the dense-cache-shaped view ragged paged
+    attention runs over.
+
+    Sentinel ids are out of bounds, and JAX gathers clamp out-of-bounds
+    indices (here: to the last pool row) — safe because every consumer
+    masks key positions >= cache_len, and the engine only dispatches
+    slots whose allocated pages cover cache_len (+ the tick's growth).
+    """
+    b, p = page_table.shape
+    gathered = pages[page_table]                    # (B, P, page, ...)
+    return gathered.reshape(b, p * pages.shape[1], *pages.shape[2:])
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, k_new, v_new,
+                           cache_len, k_scale_pages=None,
+                           v_scale_pages=None) -> jnp.ndarray:
+    """Ragged paged decode attention (pure-jnp gather formulation).
+
+    The unified-paged-KV decode op (ISSUE 6, after "Ragged Paged
+    Attention", arxiv 2604.15464): each slot's KV lives in pool pages
+    addressed by its page-table row, so sequences are ragged — HBM held
+    is ``pages_held × page`` per slot, not ``max_len``. The gather
+    reconstructs exactly the rows a dense cache would hold at positions
+    ``[0, P * page)`` and delegates to :func:`decode_attention_cached`,
+    which makes this op token-identical to the dense path by
+    construction (same einsums, same masking, same dtypes).
+
+    q: (B, 1, Hq, D); k_pages/v_pages: (num_pages, page, Hkv, D);
+    page_table: (B, P) int32 (P is the *ladder-rung* width — a static
+    shape, never derived from a live page count); k_new/v_new:
+    (B, Hkv, D) — the current token's K/V, carried explicitly exactly
+    as on the dense path (the caller scatters into the pool after);
+    cache_len: (B,) valid tokens excluding the current one. int8 pools
+    pass ``k_scale_pages``/``v_scale_pages`` (num_pages, page, Hkv).
+
+    A fused Pallas variant (gather + flash inside one kernel, no
+    materialized (B, P*page) view) is the known next step; this
+    formulation is the correctness baseline it must match.
+    """
+    k_cache = gather_kv_pages(k_pages, page_table)
+    v_cache = gather_kv_pages(v_pages, page_table)
+    k_scale = (gather_kv_pages(k_scale_pages, page_table)
+               if k_scale_pages is not None else None)
+    v_scale = (gather_kv_pages(v_scale_pages, page_table)
+               if v_scale_pages is not None else None)
+    return decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
+                                   cache_len, k_scale=k_scale,
+                                   v_scale=v_scale)
+
+
 def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
                             cache_len, k_scale=None,
                             v_scale=None) -> jnp.ndarray:

@@ -33,7 +33,7 @@ class RequestRecord:
                  "wall_enqueued_at", "enqueued_at", "admitted_at",
                  "first_token_at", "finished_at", "tokens", "status",
                  "ticks", "batch_min", "batch_max", "batch_sum",
-                 "cached_prefix_len")
+                 "cached_prefix_len", "pages_held")
 
     def __init__(self, model: str = "generate", prompt_len: int = 0,
                  budget: int = 0, trace_id: Optional[str] = None,
@@ -55,6 +55,7 @@ class RequestRecord:
         self.batch_max = 0
         self.batch_sum = 0
         self.cached_prefix_len = 0   # prompt tokens served from prefix KV
+        self.pages_held = 0          # KV pool pages mapped (paged engine)
 
     # -- event hooks (engine/batcher call these) ---------------------------
     def admitted(self) -> None:
@@ -107,6 +108,7 @@ class RequestRecord:
             "status": self.status,
             "prompt_len": self.prompt_len,
             "cached_prefix_len": self.cached_prefix_len,
+            "pages_held": self.pages_held,
             "budget": self.budget,
             "enqueued_at": self.wall_enqueued_at,
             "queue_wait_s": _round(self.queue_wait_s),

@@ -164,9 +164,12 @@ class _Flight:
 class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
                  "inflight", "queue", "temperature", "fill", "submitted_at",
-                 "deadline", "record", "req_span", "phase_span")
+                 "deadline", "record", "req_span", "phase_span", "pages",
+                 "nodes")
 
     def __init__(self):
+        self.pages: List[int] = []   # paged KV: pool pages this slot owns
+        self.nodes: List[Any] = []   # paged KV: pinned prefix-trie nodes
         self.future: Optional[asyncio.Future] = None
         self.submitted_at = 0.0    # request submit time → TTFT histogram
         self.deadline: Optional[float] = None  # abs monotonic SLO deadline
@@ -208,10 +211,15 @@ class GenerationEngine:
                  steps_per_tick: int = 1,
                  max_inflight_ticks: int = 2,
                  mesh=None,
-                 window_ladder: bool = True,
+                 window_ladder: Optional[bool] = None,
                  prefix_cache: bool = False,
                  prefix_cache_bytes: int = 64 << 20,
                  prefix_page: int = 32,
+                 paged_kv: bool = False,
+                 kv_page: int = 32,
+                 kv_pages: Optional[int] = None,
+                 kv_pool_bytes: Optional[int] = None,
+                 kv_page_reserve: Optional[int] = None,
                  logger=None, metrics=None, tracer=None, recorder=None,
                  slo=None):
         import jax
@@ -238,12 +246,44 @@ class GenerationEngine:
         self._k_ladder = [1]
         while self._k_ladder[-1] * 2 <= self.steps_per_tick:
             self._k_ladder.append(self._k_ladder[-1] * 2)
+        # unified paged KV (ISSUE 6): decode attends pool pages addressed
+        # through a per-slot page table instead of a dense
+        # (max_slots, max_len) cache row — HBM scales with the pool, not
+        # max_len x max_slots, and admission scales with free pages.
+        self.paged = bool(paged_kv)
+        self.kv_page = int(kv_page)
+        if self.paged:
+            if self.max_len % self.kv_page:
+                raise ValueError(
+                    f"paged_kv: max_len {self.max_len} must be a multiple "
+                    f"of kv_page {self.kv_page}")
+            bad = [b for b in self.prompt_buckets if b % self.kv_page]
+            if bad:
+                raise ValueError(
+                    f"paged_kv: prompt buckets {bad} are not multiples of "
+                    f"kv_page {self.kv_page} (page-aligned inserts need "
+                    f"page-aligned buckets)")
+            if mesh is not None and mesh.shape.get("dp", 1) > 1:
+                raise ValueError(
+                    "paged_kv: the shared page pool cannot shard pages "
+                    "over dp (any slot may gather any page); use a "
+                    "tp-only mesh")
         # attention-window ladder (fill-bounded decode): rungs double from
         # 128 up to max_len; a tick attends only the smallest rung covering
         # every participating slot's fill + k, so early-fill decode never
         # streams the dead tail of the static cache from HBM. The top rung
         # is encoded as window=None (identical executable to the
-        # pre-ladder design).
+        # pre-ladder design). On the paged path the rung is demoted to a
+        # page-gather width bound (table columns = rung // kv_page): paging
+        # already keeps dead HBM out of the tick, superseding windowing as
+        # the HBM relief mechanism.
+        if self.paged and window_ladder is True and logger is not None:
+            logger.warn(
+                "attention_window ladder requested together with paged_kv: "
+                "paging supersedes windowing as the HBM relief mechanism; "
+                "the window rung now only bounds the per-tick page-gather "
+                "width")
+        window_ladder = True if window_ladder is None else bool(window_ladder)
         self._window_ladder: List[Optional[int]] = [None]
         if window_ladder and self.max_len > 128:
             rungs = []
@@ -279,12 +319,51 @@ class GenerationEngine:
             specs = quantized_specs(llama_param_specs(), params)
             self.params = shard_pytree(
                 params, mesh, prune_specs(specs, mesh))
+        else:
+            self.params = jax.device_put(params)
+        self.cache = None
+        self._pool = None
+        self._table = None
+        if self.paged:
+            from gofr_tpu.tpu.page_pool import PagePool
+            self.pages_per_slot = self.max_len // self.kv_page
+            if kv_pages is not None:
+                self._pool = PagePool(cfg, page=self.kv_page,
+                                      num_pages=int(kv_pages), mesh=mesh,
+                                      metrics=metrics)
+            elif kv_pool_bytes is not None:
+                self._pool = PagePool(cfg, page=self.kv_page,
+                                      budget_bytes=int(kv_pool_bytes),
+                                      mesh=mesh, metrics=metrics)
+            else:
+                # capacity parity with the dense cache by default; real
+                # deployments size by HBM budget and admit MORE slots than
+                # dense could (slots now cost actual tokens, not max_len)
+                self._pool = PagePool(
+                    cfg, page=self.kv_page,
+                    num_pages=max_slots * self.pages_per_slot, mesh=mesh,
+                    metrics=metrics)
+            # reserve watermark: pages admission must leave free for
+            # in-flight decode growth of already-admitted slots
+            self._kv_reserve = (int(kv_page_reserve)
+                                if kv_page_reserve is not None
+                                else min(max_slots,
+                                         self._pool.num_pages // 8))
+            # per-slot page table (host master copy; device uploads are
+            # cached per gather-width and invalidated by version bumps)
+            self._table = np.full((max_slots, self.pages_per_slot),
+                                  self._pool.sentinel, np.int32)
+            self._table_version = 0
+            self._table_cache: Dict[int, Tuple[int, Any]] = {}
+            self._page_stalls = 0
+        elif mesh is not None:
+            from gofr_tpu.parallel.sharding import (  # noqa: F811
+                llama_cache_specs, prune_specs, shard_pytree)
             cache = llama.init_cache(cfg, max_slots, self.max_len)
             self.cache = shard_pytree(
                 cache, mesh,
                 prune_specs(llama_cache_specs(kv_int8=cfg.kv_int8), mesh))
         else:
-            self.params = jax.device_put(params)
             self.cache = jax.device_put(
                 llama.init_cache(cfg, max_slots, self.max_len))
         self.cache_len = jnp.zeros((max_slots,), jnp.int32)
@@ -305,12 +384,19 @@ class GenerationEngine:
         self._prefills = 0
         self.max_inflight_ticks = max(1, int(max_inflight_ticks))
         self._publishq: "deque" = deque()   # FIFO of _Fetch entries
+        # page-gated admissions (paged path): requests that fit a slot but
+        # not the pool's free pages wait here, FIFO ahead of _pending
+        self._overflow: "deque" = deque()
         self._ticks_inflight = 0
         self._cancelled_queues: set = set()  # ids of abandoned stream queues
 
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._insert_fns: Dict[Tuple[int, int], Any] = {}
         self._decode_fns: Dict[int, Any] = {}
+        # paged-path executable families: insert keyed (nb, bucket, plen),
+        # decode keyed (k, sampled, page-gather width)
+        self._insert_paged_fns: Dict[Tuple[int, int, int], Any] = {}
+        self._decode_paged_fns: Dict[Tuple[int, bool, int], Any] = {}
         # prefix KV reuse (ISSUE 4): page-granular prefix store + the
         # suffix-only prefill/insert executable families keyed
         # (nb, prefix_pages, suffix_bucket). The prefix-pages ladder
@@ -324,6 +410,11 @@ class GenerationEngine:
         self._p_ladder: List[int] = []
         if prefix_cache and self.prompt_buckets:
             from gofr_tpu.tpu.prefix_cache import PrefixStore
+            if self.paged:
+                # unified pool: prefix pages ARE decode pages, so the
+                # prefix page size must be the pool page size (a hit is a
+                # page-table entry, not a copy)
+                prefix_page = self.kv_page
             max_pages = max(self.prompt_buckets) // prefix_page
             if max_pages > 0:
                 self._p_ladder = [1]
@@ -334,7 +425,8 @@ class GenerationEngine:
                 self._prefix = PrefixStore(
                     cfg, page=prefix_page,
                     budget_bytes=prefix_cache_bytes,
-                    max_pages=max_pages, mesh=mesh, metrics=metrics)
+                    max_pages=max_pages, pool=self._pool,
+                    mesh=mesh, metrics=metrics)
             elif logger is not None:
                 logger.warn(
                     "prefix cache disabled: page size %d exceeds the "
@@ -541,6 +633,131 @@ class GenerationEngine:
             self._decode_fns[(k_steps, sampled, window)] = fn
         return fn
 
+    def _insert_paged_fn(self, nb: int, lb: int, plen: int):
+        """Paged-path insert: scatters a prefill's small cache directly
+        into freshly allocated pool pages (no dense cache exists). The
+        small cache rows [0, lb) are reshaped into ``lb // kv_page``
+        page-sized chunks per row and scattered to the flat page-id
+        vector (row-major (nb, n_pages)); sentinel ids drop. ``plen`` is
+        the static prefix length already resident in pool pages (0 for
+        full prefills) — only cache_len accounting needs it, the prefix
+        KV itself is never copied (the zero-copy admission property).
+        The pool IS donated: the engine loop serializes pool-aliasing
+        dispatches, and PjRt usage-events order in-flight non-donating
+        readers (suffix prefills) before the aliased write."""
+        fn = self._insert_paged_fns.get((nb, lb, plen))
+        if fn is None:
+            jax = self._jax
+            page = self.kv_page
+            n_pages = lb // page
+
+            def insert(pool, small, flat_ids, slots, lengths, first,
+                       cache_len, last_token, temps, top_ks, top_ps,
+                       sample_keys, new_t, new_k, new_p, new_keys):
+                # small leaves: (L, nb, lb, ...) -> (L, nb*n_pages, page,
+                # ...); pool leaves: (L, N, page, ...). One scatter per
+                # leaf publishes the whole group's KV into its pages.
+                pool = {name: pool[name].at[:, flat_ids].set(
+                    small[name].reshape(
+                        small[name].shape[0], nb * n_pages, page,
+                        *small[name].shape[3:]),
+                    mode="drop") for name in pool}
+                cache_len = cache_len.at[slots].set(plen + lengths,
+                                                    mode="drop")
+                last_token = last_token.at[slots].set(first, mode="drop")
+                temps = temps.at[slots].set(new_t, mode="drop")
+                top_ks = top_ks.at[slots].set(new_k, mode="drop")
+                top_ps = top_ps.at[slots].set(new_p, mode="drop")
+                sample_keys = sample_keys.at[slots].set(new_keys,
+                                                        mode="drop")
+                return (pool, cache_len, last_token, temps,
+                        top_ks, top_ps, sample_keys)
+
+            fn = jax.jit(insert, donate_argnums=(0, 6, 7, 8, 9, 10, 11))
+            self._insert_paged_fns[(nb, lb, plen)] = fn
+        return fn
+
+    def _decode_paged_fn(self, k_steps: int, sampled: bool = False,
+                         pw: int = 1):
+        """Paged decode-tick executable (ISSUE 6): same contract as
+        ``_decode_fn`` but attention gathers each slot's KV out of the
+        shared page pool through a ``(max_slots, pw)`` page-table slice
+        instead of indexing a dense cache row. ``pw`` is the page-gather
+        width — the window rung demoted to ``ceil(rung / kv_page)`` table
+        columns, a static ladder value. Inactive rows scatter to the
+        sentinel page id and drop."""
+        fn = self._decode_paged_fns.get((k_steps, sampled, pw))
+        if fn is None:
+            jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
+                                    self.cfg)
+            from jax import lax
+
+            if not sampled:
+                def decode_k(params, token, pool, table, cache_len, active):
+                    def one(carry, _):
+                        token, pool, cache_len = carry
+                        logits, pool2, new_len = llama.decode_step_paged(
+                            params, cfg, token, pool, table, cache_len,
+                            active)
+                        next_token = logits.argmax(axis=-1).astype(
+                            token.dtype)
+                        new_len = jnp.where(active, new_len, cache_len)
+                        next_token = jnp.where(active, next_token, token)
+                        return (next_token, pool2, new_len), next_token
+
+                    (token, pool, cache_len), tokens = lax.scan(
+                        one, (token, pool, cache_len), None, length=k_steps)
+                    return tokens, pool, cache_len   # tokens: (K, B)
+
+                fn = jax.jit(decode_k, donate_argnums=(2, 4))
+            else:
+                from gofr_tpu.ops.sampling import sample_batch
+
+                def decode_k_sampled(params, token, pool, table, cache_len,
+                                     active, temps, top_ks, top_ps, keys):
+                    def one(carry, _):
+                        token, pool, cache_len, keys = carry
+                        logits, pool2, new_len = llama.decode_step_paged(
+                            params, cfg, token, pool, table, cache_len,
+                            active)
+                        next_token, new_keys = sample_batch(
+                            logits, temps, top_ks, top_ps, keys)
+                        next_token = next_token.astype(token.dtype)
+                        new_len = jnp.where(active, new_len, cache_len)
+                        next_token = jnp.where(active, next_token, token)
+                        keys = jnp.where(active[:, None], new_keys, keys)
+                        return (next_token, pool2, new_len,
+                                keys), next_token
+
+                    (token, pool, cache_len, keys), tokens = lax.scan(
+                        one, (token, pool, cache_len, keys), None,
+                        length=k_steps)
+                    return tokens, pool, cache_len, keys
+
+                fn = jax.jit(decode_k_sampled, donate_argnums=(2, 4, 9))
+            self._decode_paged_fns[(k_steps, sampled, pw)] = fn
+        return fn
+
+    def _table_dev(self, pw: int):
+        """Device copy of the first ``pw`` page-table columns, cached per
+        gather width and invalidated by host-table version bumps. ``pw``
+        is always ladder-derived (window rung // kv_page, or the full
+        pages_per_slot) — never a live page count — so the executable set
+        stays bounded (graftcheck GT003 page-width rule)."""
+        cached = self._table_cache.get(pw)
+        if cached is not None and cached[0] == self._table_version:
+            return cached[1]
+        dev = self._jnp.asarray(self._table[:, :pw])
+        self._table_cache[pw] = (self._table_version, dev)
+        return dev
+
+    def _pick_page_width(self, rung: Optional[int]) -> int:
+        """Window rung -> page-gather width (table columns). None (full
+        window) gathers every column."""
+        if rung is None:
+            return self.pages_per_slot
+        return min(self.pages_per_slot, -(-rung // self.kv_page))
+
     def _startup_window_rungs(self, ks: List[int]) -> List[Optional[int]]:
         """Window rungs reachable right after startup: every rung up to and
         including the one covering the largest prompt bucket + the largest
@@ -646,20 +863,46 @@ class GenerationEngine:
 
         def compile_all():
             active = jnp.zeros((self.max_slots,), bool)
-            for k in rungs:
-                for window in window_rungs:
-                    tokens, cache, cache_len = self._decode_fn(
-                        k, window=window)(
-                        self.params, self.last_token, self.cache,
-                        self.cache_len, active)
-                    self.cache, self.cache_len = cache, cache_len
-                    if sampling:
-                        out = self._decode_fn(k, sampled=True,
-                                              window=window)(
+            if self.paged:
+                # window rungs demote to page-gather widths; dedup keeps
+                # the executable count <= the dense ladder's
+                widths = list(dict.fromkeys(
+                    self._pick_page_width(w) for w in window_rungs))
+                for k in rungs:
+                    for pw in widths:
+                        table = jnp.full((self.max_slots, pw),
+                                         self._pool.sentinel, jnp.int32)
+                        tokens, leaves, cache_len = self._decode_paged_fn(
+                            k, pw=pw)(
+                            self.params, self.last_token,
+                            self._pool.leaves, table, self.cache_len,
+                            active)
+                        self._pool.leaves, self.cache_len = leaves, cache_len
+                        if sampling:
+                            out = self._decode_paged_fn(
+                                k, sampled=True, pw=pw)(
+                                self.params, self.last_token,
+                                self._pool.leaves, table, self.cache_len,
+                                active, self.temps, self.top_ks,
+                                self.top_ps, self.sample_keys)
+                            (_, self._pool.leaves, self.cache_len,
+                             self.sample_keys) = out
+            else:
+                for k in rungs:
+                    for window in window_rungs:
+                        tokens, cache, cache_len = self._decode_fn(
+                            k, window=window)(
                             self.params, self.last_token, self.cache,
-                            self.cache_len, active, self.temps, self.top_ks,
-                            self.top_ps, self.sample_keys)
-                        _, self.cache, self.cache_len, self.sample_keys = out
+                            self.cache_len, active)
+                        self.cache, self.cache_len = cache, cache_len
+                        if sampling:
+                            out = self._decode_fn(k, sampled=True,
+                                                  window=window)(
+                                self.params, self.last_token, self.cache,
+                                self.cache_len, active, self.temps,
+                                self.top_ks, self.top_ps, self.sample_keys)
+                            (_, self.cache, self.cache_len,
+                             self.sample_keys) = out
             for lb in self.prompt_buckets:
                 for n in prompt_counts:
                     nb = next(x for x in self._n_ladder if x >= n)
@@ -673,14 +916,29 @@ class GenerationEngine:
                         self.params, toks, lens, zeros_f, zeros_i, ones_f,
                         seeds)
                     slots = jnp.full((nb,), self.max_slots, jnp.int32)
-                    (self.cache, self.cache_len, self.last_token,
-                     self.temps, self.top_ks, self.top_ps,
-                     self.sample_keys) = self._insert_fn(nb, lb)(
-                        self.cache, small, slots, lens, first,
-                        self.cache_len, self.last_token, self.temps,
-                        self.top_ks, self.top_ps, self.sample_keys,
-                        zeros_f, zeros_i, ones_f, keys)
-            self._jax.block_until_ready(self.cache)
+                    if self.paged:
+                        flat = jnp.full((nb * (lb // self.kv_page),),
+                                        self._pool.sentinel, jnp.int32)
+                        (leaves, self.cache_len, self.last_token,
+                         self.temps, self.top_ks, self.top_ps,
+                         self.sample_keys) = self._insert_paged_fn(
+                            nb, lb, 0)(
+                            self._pool.leaves, small, flat, slots, lens,
+                            first, self.cache_len, self.last_token,
+                            self.temps, self.top_ks, self.top_ps,
+                            self.sample_keys, zeros_f, zeros_i, ones_f,
+                            keys)
+                        self._pool.leaves = leaves
+                    else:
+                        (self.cache, self.cache_len, self.last_token,
+                         self.temps, self.top_ks, self.top_ps,
+                         self.sample_keys) = self._insert_fn(nb, lb)(
+                            self.cache, small, slots, lens, first,
+                            self.cache_len, self.last_token, self.temps,
+                            self.top_ks, self.top_ps, self.sample_keys,
+                            zeros_f, zeros_i, ones_f, keys)
+            self._jax.block_until_ready(
+                self._pool.leaves if self.paged else self.cache)
 
         await loop.run_in_executor(None, compile_all)
 
@@ -782,6 +1040,7 @@ class GenerationEngine:
                 slot.gen += 1          # stale in-flight tokens are dropped
                 slot.inflight = 0
                 slot.queue = None
+                self._release_slot_kv(slot_idx, slot)
                 self._finish_slot(slot, "cancelled")
                 if slot.future is not None and not slot.future.done():
                     slot.future.cancel()
@@ -818,6 +1077,13 @@ class GenerationEngine:
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
             out["prefix_cache"]["page_ladder"] = list(self._p_ladder)
+        if self.paged:
+            pool = self._pool.stats()
+            pool["reserve_pages"] = self._kv_reserve
+            pool["pages_per_slot"] = self.pages_per_slot
+            pool["page_stalls"] = self._page_stalls
+            pool["deferred_requests"] = len(self._overflow)
+            out["kv_pool"] = pool
         return out
 
     def statusz(self, recent: int = 32) -> Dict[str, Any]:
@@ -833,22 +1099,47 @@ class GenerationEngine:
                 "remaining": slot.remaining if slot.active else 0,
                 "inflight_tokens": slot.inflight,
                 "streaming": slot.queue is not None,
+                "pages_held": (len(slot.pages) + len(slot.nodes)
+                               if slot.active else 0),
                 "trace_id": (slot.record.trace_id
                              if slot.record is not None else None),
             })
         tokens_in_cache = sum(s.fill for s in self._slots if s.active)
-        capacity = self.max_slots * self.max_len
-        return {
-            "queue_depth": self._pending.qsize(),
-            "ticks_inflight": self._ticks_inflight,
-            "slots": slots,
-            "kv_cache": {
+        if self.paged:
+            # occupancy against the POOL, not max_slots x max_len — paged
+            # HBM is the pool, and live tokens ride actual pages
+            capacity = self._pool.num_pages * self.kv_page
+            pages_held = sum(len(s.pages) + len(s.nodes)
+                             for s in self._slots if s.active)
+            kv_cache = {
+                "paged": True,
+                "max_slots": self.max_slots,
+                "max_len": self.max_len,
+                "page_tokens": self.kv_page,
+                "pool_pages": self._pool.num_pages,
+                "pages_in_use": self._pool.used_pages,
+                "slot_pages_held": pages_held,
+                "tokens_in_cache": tokens_in_cache,
+                "occupancy": round(tokens_in_cache / capacity, 6)
+                if capacity else 0.0,
+                "ragged_fill_ratio": round(
+                    tokens_in_cache / (pages_held * self.kv_page), 6)
+                if pages_held else 0.0,
+            }
+        else:
+            capacity = self.max_slots * self.max_len
+            kv_cache = {
                 "max_slots": self.max_slots,
                 "max_len": self.max_len,
                 "tokens_in_cache": tokens_in_cache,
                 "occupancy": round(tokens_in_cache / capacity, 6)
                 if capacity else 0.0,
-            },
+            }
+        return {
+            "queue_depth": self._pending.qsize(),
+            "ticks_inflight": self._ticks_inflight,
+            "slots": slots,
+            "kv_cache": kv_cache,
             "stats": self.stats(),
             "requests": self.recorder.snapshot(limit=recent),
         }
@@ -889,6 +1180,16 @@ class GenerationEngine:
                 "store": self._prefix.stats(),
                 "prefill_bucket_tokens": self._prefill_bucket_tokens,
                 "prefill_real_tokens": self._prefill_real_tokens,
+            }
+        if self.paged:
+            # the page-gather width ladder is the paged path's analogue of
+            # the attention-window ladder: one decode executable per
+            # (k, sampled, width), width always ladder-derived
+            out["paged_kv"] = {
+                "page_tokens": self.kv_page,
+                "gather_widths": sorted({self._pick_page_width(w)
+                                         for w in self._window_ladder}),
+                "pool": self._pool.stats(),
             }
         return out
 
@@ -954,16 +1255,31 @@ class GenerationEngine:
         original shardings). Loses in-progress KV state — callers were
         already failed by _fail_outstanding."""
         jnp, llama = self._jnp, self._llama
-        cache = llama.init_cache(self.cfg, self.max_slots, self.max_len)
-        if self.mesh is not None:
+        if self.paged:
+            # rebuild the pool leaves and drop every page mapping: slots
+            # were already failed, so the table goes back to all-sentinel
+            # (the shared prefix index resets below without re-touching
+            # the pool it no longer owns)
+            self._pool.reset()
+            self._table = np.full(
+                (self.max_slots, self.pages_per_slot),
+                self._pool.sentinel, np.int32)
+            self._table_version += 1
+            self._table_cache.clear()
+            for slot in self._slots:
+                slot.pages = []
+                slot.nodes = []
+        elif self.mesh is not None:
             from gofr_tpu.parallel.sharding import (
                 llama_cache_specs, prune_specs, shard_pytree)
+            cache = llama.init_cache(self.cfg, self.max_slots, self.max_len)
             self.cache = shard_pytree(
                 cache, self.mesh,
                 prune_specs(llama_cache_specs(kv_int8=self.cfg.kv_int8),
                             self.mesh))
         else:
-            self.cache = self._jax.device_put(cache)
+            self.cache = self._jax.device_put(
+                llama.init_cache(self.cfg, self.max_slots, self.max_len))
         self.cache_len = jnp.zeros((self.max_slots,), jnp.int32)
         self.last_token = jnp.zeros((self.max_slots,), jnp.int32)
         self.temps = jnp.zeros((self.max_slots,), jnp.float32)
@@ -988,6 +1304,7 @@ class GenerationEngine:
                 slot.active = False
                 slot.gen += 1
                 slot.inflight = 0
+                self._release_slot_kv(slot_idx, slot)
                 self._finish_slot(slot, "error")
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_exception(exc)
@@ -1021,7 +1338,8 @@ class GenerationEngine:
                 dispatched = True
 
         if not q:
-            if self.active_slots == 0 and self._pending.empty():
+            if (self.active_slots == 0 and self._pending.empty()
+                    and not self._overflow):
                 self._wake.clear()
                 await self._wake.wait()
             return
@@ -1092,6 +1410,10 @@ class GenerationEngine:
         gathering cached pages). Returns [(first_dev, [(slot, gen, row)])]
         fetch handles for the first generated tokens."""
         requests: List[Tuple] = []
+        # page-deferred requests re-enter FIRST (FIFO fairness: they were
+        # admitted-in-order before the pool ran short)
+        while self._overflow and self._free[len(requests):]:
+            requests.append(self._overflow.popleft())
         while self._free[len(requests):] and not self._pending.empty():
             requests.append(self._pending.get_nowait())
         if not requests:
@@ -1101,8 +1423,10 @@ class GenerationEngine:
                             Optional[Span]]] = []
         by_group: Dict[Tuple[int, int], List[Tuple]] = {}
         leases: List[Any] = []
-        for prompt, bucket, budget, eos_id, sampling, future, queue, \
-                submitted_at, flight in requests:
+        committed = 0      # pages promised to requests admitted this pass
+        for ri, request in enumerate(requests):
+            prompt, bucket, budget, eos_id, sampling, future, queue, \
+                submitted_at, flight = request
             if queue is not None and queue in self._cancelled_queues:
                 # stream consumer vanished before admission: drop it
                 self._cancelled_queues.discard(queue)
@@ -1134,14 +1458,52 @@ class GenerationEngine:
                         "(%.1fms past deadline)",
                         (time.monotonic() - flight.deadline) * 1000.0)
                 continue
+            if self.paged:
+                # admission is page-gated, BEFORE the prefix lookup so a
+                # deferred request doesn't double-count hit/save metrics
+                # when it retries. Worst case: the whole prompt needs
+                # fresh pages; the reserve keeps headroom for decode
+                # growth of slots already running.
+                need_max = -(-len(prompt) // self.kv_page)
+                if need_max + self._kv_reserve > self._pool.num_pages:
+                    exc = RuntimeError(
+                        f"prompt needs {need_max} KV pages but the pool "
+                        f"holds {self._pool.num_pages} (reserve "
+                        f"{self._kv_reserve}); it can never be admitted")
+                    if not future.done():
+                        future.set_exception(exc)
+                    if queue is not None:
+                        queue.put_nowait(exc)
+                    if flight.qspan is not None:
+                        flight.qspan.set_status("ERROR")
+                        flight.qspan.finish()
+                    self.recorder.finish(flight.record, "error")
+                    continue
+                while (self._pool.free_pages - committed
+                        < need_max + self._kv_reserve
+                        and self._prefix is not None
+                        and self._prefix.evict_one()):
+                    pass
+                if (self._pool.free_pages - committed
+                        < need_max + self._kv_reserve):
+                    # head-of-line FIFO: defer this and everything popped
+                    # after it (admitting a shorter later request first
+                    # would starve long prompts under pressure)
+                    self._overflow.extend(requests[ri:])
+                    break
+                committed += need_max
             p_rung, sb, page_ids, nodes = (
                 self._prefix_plan(prompt, bucket)
                 if self._prefix is not None else (0, bucket, [], []))
-            leases.extend(nodes)
+            if not self.paged:
+                # dense: pins last only until this admission pass's
+                # dispatches are ordered; paged slots keep their nodes
+                # pinned for the slot's lifetime (pages ARE the cache)
+                leases.extend(nodes)
             by_group.setdefault((p_rung, sb), []).append(
                 (prompt, budget, eos_id, sampling, future, queue,
-                 submitted_at, flight, page_ids))
-        if self._pending.empty():
+                 submitted_at, flight, page_ids, nodes))
+        if self._pending.empty() and not self._overflow:
             # no queued request can match a leftover entry any more —
             # bound the set (cancel-after-completion would otherwise leak)
             self._cancelled_queues.clear()
@@ -1162,9 +1524,16 @@ class GenerationEngine:
             top_ps = np.ones((nb,), np.float32)
             seeds = np.zeros((nb,), np.uint32)
             page_mat = np.zeros((nb, p_rung), np.int32)
+            # paged path: fresh page ids per (row, suffix page), row-major,
+            # sentinel where the row has no page (padding rows / short
+            # suffixes) — the insert scatter drops those
+            npg = bucket // self.kv_page if self.paged else 0
+            flat_ids = (np.full((nb * npg,), self._pool.sentinel, np.int32)
+                        if self.paged else None)
             claimed: List[Tuple[int, int, int]] = []          # (slot,gen,row)
             for row, (prompt, budget, eos_id, sampling, future, queue,
-                      submitted_at, flight, page_ids) in enumerate(group):
+                      submitted_at, flight, page_ids,
+                      nodes) in enumerate(group):
                 slot_idx = self._free.pop()
                 slot = self._slots[slot_idx]
                 slot.future = future
@@ -1203,6 +1572,40 @@ class GenerationEngine:
                 self._prefill_real_tokens += len(suffix)
                 if p_rung:
                     page_mat[row] = page_ids
+                if self.paged:
+                    # prefix hit = table entries, zero KV copies: the
+                    # pinned trie nodes' pages map straight into columns
+                    # [0, p_rung); fresh suffix pages follow. The reserve
+                    # gating above guarantees the alloc (reclaim backstop
+                    # evicts cold prefixes if it somehow doesn't).
+                    slot.nodes = list(nodes)
+                    for j, node in enumerate(nodes):
+                        self._table[slot_idx, j] = node.page_id
+                    n_fresh = -(-len(suffix) // self.kv_page)
+                    ids = self._pool.alloc(
+                        n_fresh,
+                        reclaim=(self._prefix.evict_one
+                                 if self._prefix is not None else None))
+                    if ids is None:
+                        raise RuntimeError(
+                            f"kv page pool exhausted at admission: "
+                            f"{n_fresh} pages wanted, "
+                            f"{self._pool.free_pages} free")
+                    slot.pages = list(ids)
+                    for j, pid in enumerate(ids):
+                        self._table[slot_idx, p_rung + j] = pid
+                    self._table_version += 1
+                    flight.record.pages_held = p_rung + n_fresh
+                    for j in range(n_fresh):
+                        flat_ids[row * npg + j] = ids[j]
+                    if p_rung == 0 and self._prefix is not None:
+                        # zero-copy publish: fully-valid prompt pages are
+                        # adopted by the trie (one retain per new page);
+                        # the page decode writes into stays slot-private
+                        want = min(len(prompt) // self.kv_page,
+                                   self._prefix.max_pages)
+                        if want > 0:
+                            self._prefix.register(prompt, ids[:want])
                 slots[row] = slot_idx
                 temps[row] = max(sampling.temperature, 0.0)
                 top_ks[row] = sampling.top_k
@@ -1214,7 +1617,7 @@ class GenerationEngine:
             # the store (dedup'd: already-cached pages keep the num_pages
             # sentinel and the scatter drops them)
             publish_ids = None
-            if p_rung == 0 and self._prefix is not None:
+            if p_rung == 0 and self._prefix is not None and not self.paged:
                 store = self._prefix
                 np_max = min(bucket // store.page, store.max_pages)
                 if np_max > 0:
@@ -1233,7 +1636,50 @@ class GenerationEngine:
                     if new_any:
                         publish_ids = flat
 
-            if p_rung == 0:
+            if self.paged:
+                def dispatch(p=p_rung, bucket=bucket, nb=nb, padded=padded,
+                             lengths=lengths, slots=slots, temps=temps,
+                             top_ks=top_ks, top_ps=top_ps, seeds=seeds,
+                             page_mat=page_mat, flat_ids=flat_ids,
+                             plen=plen):
+                    if p == 0:
+                        first, small, keys = self._prefill_fn(nb, bucket)(
+                            self.params, jnp.asarray(padded),
+                            jnp.asarray(lengths),
+                            jnp.asarray(temps), jnp.asarray(top_ks),
+                            jnp.asarray(top_ps), jnp.asarray(seeds))
+                    else:
+                        # suffix prefill reads the SAME pool leaves the
+                        # insert below donates — PjRt usage events order
+                        # the read before the aliased write
+                        first, small, keys = self._suffix_prefill_fn(
+                            nb, p, bucket)(
+                            self.params, self._pool.leaves,
+                            jnp.asarray(page_mat), jnp.asarray(padded),
+                            jnp.asarray(lengths), jnp.asarray(temps),
+                            jnp.asarray(top_ks), jnp.asarray(top_ps),
+                            jnp.asarray(seeds))
+                    (leaves, self.cache_len, self.last_token, self.temps,
+                     self.top_ks, self.top_ps, self.sample_keys) = \
+                        self._insert_paged_fn(nb, bucket, plen)(
+                            self._pool.leaves, small,
+                            jnp.asarray(flat_ids), jnp.asarray(slots),
+                            jnp.asarray(lengths), first,
+                            self.cache_len, self.last_token, self.temps,
+                            self.top_ks, self.top_ps, self.sample_keys,
+                            jnp.asarray(temps), jnp.asarray(top_ks),
+                            jnp.asarray(top_ps), keys)
+                    self._pool.leaves = leaves
+                    self._pool.note_writes(
+                        int((flat_ids != self._pool.sentinel).sum()))
+                    return first
+
+                warm = ((nb, bucket, plen) in self._insert_paged_fns
+                        and ((nb, bucket) in self._prefill_fns
+                             if p_rung == 0 else
+                             (nb, p_rung, bucket)
+                             in self._suffix_prefill_fns))
+            elif p_rung == 0:
                 def dispatch(bucket=bucket, nb=nb, padded=padded,
                              lengths=lengths, slots=slots, temps=temps,
                              top_ks=top_ks, top_ps=top_ps, seeds=seeds,
@@ -1357,6 +1803,18 @@ class GenerationEngine:
             for rung in self._k_ladder:
                 if rung <= min_wanted:
                     k = rung
+        if self.paged:
+            covered = self._cover_pages(eligible, k)
+            if not covered:
+                # every eligible slot is short of pages and nothing can be
+                # reclaimed. In-flight ticks will free pages when their
+                # slots complete; with NONE in flight the pool is
+                # wedged — shed the newest request to unwedge (its pages
+                # restart the oldest slots).
+                if self._ticks_inflight == 0:
+                    self._shed_newest(eligible)
+                return None
+            eligible = covered
         active = np.zeros((self.max_slots,), bool)
         snapshot = []
         sampled = False
@@ -1379,8 +1837,25 @@ class GenerationEngine:
             self._mask_dev = jnp.asarray(active)
             self._mask_key = key
 
+        pw = self._pick_page_width(window) if self.paged else 0
+
         def dispatch():
-            if sampled:
+            if self.paged:
+                table = self._table_dev(pw)
+                if sampled:
+                    (tokens_dev, leaves, self.cache_len,
+                     self.sample_keys) = self._decode_paged_fn(
+                        k, sampled=True, pw=pw)(
+                        self.params, self.last_token, self._pool.leaves,
+                        table, self.cache_len, self._mask_dev, self.temps,
+                        self.top_ks, self.top_ps, self.sample_keys)
+                else:
+                    (tokens_dev, leaves,
+                     self.cache_len) = self._decode_paged_fn(k, pw=pw)(
+                        self.params, self.last_token, self._pool.leaves,
+                        table, self.cache_len, self._mask_dev)
+                self._pool.leaves = leaves
+            elif sampled:
                 (tokens_dev, self.cache, self.cache_len,
                  self.sample_keys) = self._decode_fn(
                     k, sampled=True, window=window)(
@@ -1398,7 +1873,9 @@ class GenerationEngine:
         step_span = self._step_span("tpu.engine.step", snapshot,
                                     k=k, window=window or self.max_len,
                                     sampled=sampled, step=self._steps)
-        if (k, sampled, window) in self._decode_fns:
+        warm = ((k, sampled, pw) in self._decode_paged_fns if self.paged
+                else (k, sampled, window) in self._decode_fns)
+        if warm:
             tokens_dev = dispatch()
         else:
             tokens_dev = await loop.run_in_executor(None, dispatch)
@@ -1415,7 +1892,69 @@ class GenerationEngine:
             self.metrics.set_gauge(
                 "app_tpu_attention_window",
                 float(window or self.max_len), model="generate")
+            if self.paged:
+                held = sum(len(s.nodes) + len(s.pages)
+                           for _, s in eligible)
+                filled = sum(s.fill for _, s in eligible)
+                if held:
+                    self.metrics.set_gauge(
+                        "app_tpu_kv_ragged_fill_ratio",
+                        min(1.0, filled / (held * self.kv_page)),
+                        model="generate")
         return tokens_dev, snapshot, step_span
+
+    def _cover_pages(self, eligible, k: int):
+        """Grow each participating slot's page chain to cover its fill + k
+        tokens, reclaiming cold prefix pages when the free list runs
+        short. Slots that cannot be covered sit this tick out (admission
+        backpressure, not an error): their pages come back when other
+        slots complete."""
+        covered = []
+        for slot_idx, slot in eligible:
+            need = -(-(slot.fill + k) // self.kv_page)
+            held = len(slot.nodes) + len(slot.pages)
+            short = need - held
+            if short > 0:
+                ids = self._pool.alloc(
+                    short, reclaim=(self._prefix.evict_one
+                                    if self._prefix is not None else None))
+                if ids is None:
+                    self._page_stalls += 1
+                    continue
+                for j, pid in enumerate(ids):
+                    self._table[slot_idx, held + j] = pid
+                slot.pages.extend(ids)
+                self._table_version += 1
+                if slot.record is not None:
+                    slot.record.pages_held = need
+            covered.append((slot_idx, slot))
+        return covered
+
+    def _shed_newest(self, eligible) -> None:
+        """Pool-wedge breaker: every decodable slot is short of pages,
+        nothing is reclaimable, and no tick is in flight to free any —
+        fail the NEWEST request (LIFO shed preserves the most sunk work)
+        so its pages unwedge the rest."""
+        slot_idx, slot = max(eligible, key=lambda e: e[1].submitted_at)
+        exc = RuntimeError(
+            "kv page pool wedged: no slot can grow and nothing is "
+            "reclaimable; shedding the newest request")
+        if self.logger is not None:
+            self.logger.error(
+                "engine: %s (slot %d, %d pages back to the pool)",
+                exc, slot_idx, len(slot.pages) + len(slot.nodes))
+        slot.active = False
+        slot.gen += 1
+        slot.inflight = 0
+        self._release_slot_kv(slot_idx, slot)
+        self._finish_slot(slot, "error")
+        if slot.future is not None and not slot.future.done():
+            slot.future.set_exception(exc)
+        if slot.queue is not None:
+            slot.queue.put_nowait(exc)
+            slot.queue = None
+        if slot_idx not in self._free:
+            self._free.append(slot_idx)
 
     def _push_tokens(self, slot_idx: int, gen: int,
                      tokens: List[int]) -> None:
@@ -1464,6 +2003,7 @@ class GenerationEngine:
             if (slot.remaining <= 0
                     or (slot.eos_id is not None and token == slot.eos_id)):
                 slot.active = False    # rest of the chunk is discarded
+                self._release_slot_kv(slot_idx, slot)
                 self._free.append(slot_idx)
                 if self.slo is not None:
                     # terminal classification: within deadline (or no
@@ -1479,6 +2019,28 @@ class GenerationEngine:
                     slot.queue.put_nowait(_DONE)
                     slot.queue = None
                 break
+
+    def _release_slot_kv(self, slot_idx: int, slot: _Slot) -> None:
+        """Return a finished slot's KV footprint to the shared pool
+        (paged path only): its own pages drop to the free list when their
+        refcount hits zero — pages adopted by the prefix trie survive
+        with the trie's reference — and its pinned prefix nodes unpin
+        (refcounted reclaim; eviction frees the underlying pages later).
+        The table row goes back to all-sentinel so a recycled slot can
+        never gather a stale page."""
+        if not self.paged:
+            return
+        if slot.nodes:
+            if self._prefix is not None:
+                self._prefix.release(slot.nodes)
+            slot.nodes = []
+        if slot.pages:
+            self._pool.release(slot.pages)
+            slot.pages = []
+        row = self._table[slot_idx]
+        if (row != self._pool.sentinel).any():
+            row.fill(self._pool.sentinel)
+            self._table_version += 1
 
     def _finish_slot(self, slot: _Slot, status: str) -> None:
         """Close a slot's observability state: finish the open phase span

@@ -1,0 +1,216 @@
+"""Shared device-resident KV page pool (ISSUE 6).
+
+One pool backs every KV byte of the paged serving path: prefill output,
+the radix prefix cache, and decode appends all address the same
+``(L, num_pages, page, Hkv, Dh)`` arrays (int8 caches add the scale
+planes ``(L, num_pages, page, Hkv)``). The dense engine kept one
+``(max_slots, max_len, ...)`` cache whose HBM cost was the *worst-case*
+sequence length times the slot count; here HBM is ``num_pages × page``
+tokens regardless of ``max_len``, and slot count scales with the actual
+token footprint of live traffic (the ragged-paged-attention layout from
+PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464; sizing by real
+footprint instead of static worst case follows the batch-size/latency
+study, arxiv 1812.11731).
+
+Host-side state is a free list plus a per-page refcount:
+
+- ``alloc`` hands out pages at refcount 1 (the allocating owner — an
+  engine slot or a prefix-trie node).
+- ``retain``/``release`` move shared ownership: a slot's page that the
+  prefix trie adopts is retained once by the trie, so the page outlives
+  the slot; release drops a ref and returns the page to the free list at
+  zero.
+- ``alloc`` takes an optional ``reclaim`` callback (the prefix store's
+  LRU leaf eviction): it is invoked while the free list is short and may
+  release pages; allocation is all-or-nothing and never blocks.
+
+``num_pages`` doubles as the out-of-bounds sentinel id: scatters use
+``mode="drop"`` so a sentinel entry writes nothing, and gathers clamp —
+the clamped garbage is always masked by ``cache_len`` downstream.
+
+Device arrays live in ``leaves``; owners that run donating executables
+(the engine's decode tick / paged insert) write the returned arrays
+back. All dispatches are serialized on the engine loop, so handle churn
+is single-writer; JAX dataflow orders in-flight readers before the
+donated buffer is reused.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["PagePool"]
+
+
+class PagePool:
+    """Refcounted device page pool shared by prefill, prefix cache, and
+    decode. ``num_pages`` may be given directly or derived from
+    ``budget_bytes`` (HBM cap across every leaf)."""
+
+    def __init__(self, cfg, page: int = 32,
+                 num_pages: Optional[int] = None,
+                 budget_bytes: Optional[int] = None,
+                 mesh=None, metrics=None):
+        import jax
+        import numpy as np
+
+        self._jax = jax
+        self._np = np
+        self.cfg = cfg
+        self.mesh = mesh
+        self.metrics = metrics
+        self.page = int(page)
+        self.page_bytes = self._page_bytes(cfg, self.page)
+        if num_pages is not None:
+            self.num_pages = int(num_pages)
+        elif budget_bytes is not None:
+            self.num_pages = max(1, int(budget_bytes) // self.page_bytes)
+        else:
+            raise ValueError("PagePool needs num_pages or budget_bytes")
+        # cumulative counters (survive reset: pool history, not contents)
+        self.writes = 0        # page-rows scattered into the pool
+        self.stalls = 0        # failed allocations (free list exhausted)
+        self.allocs = 0
+        self.leaves: Dict[str, Any] = {}
+        self._free: List[int] = []
+        self._refs = np.zeros((self.num_pages,), np.int32)
+        self.reset()
+
+    @property
+    def sentinel(self) -> int:
+        """Out-of-bounds page id: dropped by scatters, clamped (and then
+        length-masked) by gathers."""
+        return self.num_pages
+
+    @staticmethod
+    def _page_bytes(cfg, page: int) -> int:
+        """HBM bytes one page occupies across every cache leaf."""
+        import jax.numpy as jnp
+
+        kv = cfg.n_layers * page * cfg.n_kv_heads * cfg.head_dim
+        if cfg.kv_int8:
+            scales = cfg.n_layers * page * cfg.n_kv_heads * 4
+            return 2 * (kv + scales)          # int8 k+v, f32 ks+vs
+        return 2 * kv * jnp.dtype(cfg.dtype).itemsize
+
+    def _init_leaves(self) -> None:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        shape = (cfg.n_layers, self.num_pages, self.page, cfg.n_kv_heads,
+                 cfg.head_dim)
+        if cfg.kv_int8:
+            leaves = {"k": jnp.zeros(shape, jnp.int8),
+                      "v": jnp.zeros(shape, jnp.int8),
+                      "ks": jnp.ones(shape[:-1], jnp.float32),
+                      "vs": jnp.ones(shape[:-1], jnp.float32)}
+        else:
+            leaves = {"k": jnp.zeros(shape, cfg.dtype),
+                      "v": jnp.zeros(shape, cfg.dtype)}
+        if self.mesh is not None:
+            # any slot gathers any page, so rows cannot shard over dp;
+            # kv-heads shard over tp exactly like the dense cache
+            from gofr_tpu.parallel.sharding import (
+                llama_prefix_pool_specs, prune_specs, shard_pytree)
+            leaves = shard_pytree(
+                leaves, self.mesh,
+                prune_specs(llama_prefix_pool_specs(kv_int8=cfg.kv_int8),
+                            self.mesh))
+        else:
+            leaves = self._jax.device_put(leaves)
+        self.leaves = leaves
+
+    def reset(self) -> None:
+        """Fresh device buffers, empty ownership. Called at engine
+        device-state reset: a failed donating executable may have
+        poisoned any in-flight handle. Honors a caller-resized
+        ``num_pages`` (tests shrink pools to force eviction)."""
+        self._free = list(range(self.num_pages))
+        self._refs = self._np.zeros((self.num_pages,), self._np.int32)
+        self._init_leaves()
+        self._set_gauges()
+
+    # -- ownership ----------------------------------------------------------
+    def alloc(self, n: int = 1,
+              reclaim: Optional[Callable[[], bool]] = None
+              ) -> Optional[List[int]]:
+        """Allocate ``n`` pages at refcount 1, all-or-nothing. While the
+        free list is short, ``reclaim()`` (if given) is called to release
+        evictable pages; it returns False when it has nothing left. On
+        failure returns None and counts a stall — never blocks."""
+        while len(self._free) < n and reclaim is not None and reclaim():
+            pass
+        if len(self._free) < n:
+            self.stalls += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_tpu_kv_pages_stalled_total")
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        for pid in ids:
+            self._refs[pid] = 1
+        self.allocs += n
+        self._set_gauges()
+        return ids
+
+    def retain(self, page_ids: Sequence[int]) -> None:
+        for pid in page_ids:
+            self._refs[pid] += 1
+
+    def release(self, page_ids: Sequence[int]) -> None:
+        """Drop one ref per page; refcount 0 returns the page to the free
+        list. Releasing an already-free page is a no-op (reset guards)."""
+        for pid in page_ids:
+            if self._refs[pid] > 0:
+                self._refs[pid] -= 1
+                if self._refs[pid] == 0:
+                    self._free.append(pid)
+        self._set_gauges()
+
+    def note_writes(self, pages: int) -> None:
+        """Count page-rows an owner's scatter actually wrote (sentinel
+        entries excluded) — the zero-copy-admission proof reads this."""
+        if pages <= 0:
+            return
+        self.writes += pages
+        if self.metrics is not None:
+            self.metrics.delta_updown_counter(
+                "app_tpu_kv_pages_written_total", float(pages))
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def pool_bytes(self) -> int:
+        return self.num_pages * self.page_bytes
+
+    def refs(self, pid: int) -> int:
+        return int(self._refs[pid])
+
+    def _set_gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_tpu_kv_pages_used",
+                                   float(self.used_pages))
+            self.metrics.set_gauge("app_tpu_kv_pages_capacity",
+                                   float(self.num_pages))
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "page_tokens": self.page,
+            "num_pages": self.num_pages,
+            "used_pages": self.used_pages,
+            "free_pages": self.free_pages,
+            "page_bytes": self.page_bytes,
+            "pool_bytes": self.pool_bytes,
+            "occupancy": (round(self.used_pages / self.num_pages, 6)
+                          if self.num_pages else 0.0),
+            "allocs": self.allocs,
+            "writes": self.writes,
+            "stalls": self.stalls,
+        }

@@ -1,38 +1,42 @@
-"""Device-resident prefix KV cache for the generate engine (ISSUE 4).
+"""Device-resident prefix KV cache for the generate engine (ISSUE 4/6).
 
 Real /generate traffic is dominated by shared prompt prefixes (system
 prompts, few-shot templates); recomputing them on every admission burns
 prefill FLOPs and TTFT on tokens whose KV was produced seconds ago. This
 module keeps that KV: a host-side trie over *page-aligned* prompt token
 ids maps each page (a fixed run of ``page`` tokens) to one row of a
-device-resident page pool, so a later prompt sharing the prefix prefills
-only its suffix (models/llama.prefill ``prefix=``/``prefix_len=``).
+device-resident page pool (tpu/page_pool.PagePool), so a later prompt
+sharing the prefix prefills only its suffix (models/llama.prefill
+``prefix=``/``prefix_len=``).
 
 Design (Ragged Paged Attention's layout lesson, PAPERS.md — block-granular
 KV is how flexible reuse stays static-shape on TPU):
 
-- **Page pool**: one array per KV-cache leaf, shaped
-  ``(L, num_pages, page, Hkv, Dh)`` (int8 caches add the scale planes
-  ``(L, num_pages, page, Hkv)``), allocated once under an HBM byte budget
-  and sharded like the main cache (kv-heads on ``tp`` —
-  parallel/sharding.llama_prefix_pool_specs). ``num_pages`` doubles as
-  the out-of-bounds sentinel page id for ``mode="drop"`` scatters.
+- **Page pool**: owned by the store on the dense engine path (the trie is
+  the only pool client), or *shared* with the engine's unified paged KV
+  pool (``pool=`` at construction) — then prefix pages, prefill output,
+  and decode KV are all rows of the same arrays and a prefix hit is a
+  page-table entry, not a copy.
 - **Trie index (host)**: each node is one page keyed by its token tuple;
   a chain of nodes from the root spells a cached prefix. Pure host
   bookkeeping — lookups never touch the device.
-- **Refcounting**: the engine pins the nodes it is about to gather from
-  (``acquire``) for the span of one admission pass, so a concurrent
-  publish in the same pass can never evict-and-overwrite a page an
-  in-flight suffix prefill will read.
-- **LRU eviction**: when the pool is full, the least-recently-used
+- **Refcounting**: two layers. ``node.refs`` pins a node against trie
+  eviction while an engine slot plans a gather from it (dense: one
+  admission pass; paged: the slot's whole lifetime, since decode reads
+  the page every tick). Each trie node also holds exactly one *pool*
+  ref on its page, dropped at eviction — a page adopted from a slot
+  (:meth:`register`) therefore outlives the slot.
+- **LRU eviction**: when the pool runs short, the least-recently-used
   *leaf* node (no children, refcount 0) is evicted — interior nodes are
   never evicted before their descendants, so every surviving chain stays
-  walkable.
-- **Publish without donation**: the scatter publishing new pages returns
-  a fresh pool array (the old one is NOT donated) — earlier-dispatched
-  suffix prefills still hold the previous snapshot, so device-order
-  hazards cannot corrupt a read. The transient cost is one extra pool
-  allocation per publish, bounded by the byte budget.
+  walkable. Eviction is also the pool's ``reclaim`` hook, so a paged
+  engine starved of free pages reclaims cold prefixes automatically.
+- **Publish without donation** (dense path): the scatter publishing new
+  pages returns a fresh pool array (the old one is NOT donated) —
+  earlier-dispatched suffix prefills still hold the previous snapshot,
+  so device-order hazards cannot corrupt a read. On the paged path
+  there is no publish scatter at all: full prefills write pages in
+  place and :meth:`register` adopts the ids.
 
 Determinism contract: with a bf16 KV cache the pooled pages hold exactly
 the bf16 K/V a full prefill would recompute, so greedy decode is
@@ -46,13 +50,15 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from gofr_tpu.tpu.page_pool import PagePool
+
 __all__ = ["PrefixStore"]
 
 
 class _PageNode:
     """One cached page: ``key`` is the page's token tuple, ``page_id`` its
     row in the device pool. ``refs`` pins it against eviction while an
-    admission pass plans a gather from it."""
+    engine slot reads from it."""
 
     __slots__ = ("key", "parent", "children", "page_id", "refs",
                  "last_used")
@@ -68,30 +74,37 @@ class _PageNode:
 
 
 class PrefixStore:
-    """Prefix KV store: host trie index + device page pool.
+    """Prefix KV store: host trie index over a device page pool.
 
-    ``page`` tokens per page; ``budget_bytes`` caps the pool's HBM
+    ``page`` tokens per page; ``budget_bytes`` caps an *owned* pool's HBM
     footprint (``num_pages`` overrides the derived count — unit tests);
     ``max_pages`` caps how long a cached prefix may grow (pages past it
-    are neither looked up nor published)."""
+    are neither looked up nor published). Pass ``pool=`` to index into a
+    shared :class:`PagePool` instead of owning one."""
 
     def __init__(self, cfg, page: int = 32,
                  budget_bytes: int = 64 << 20,
                  max_pages: int = 0,
                  num_pages: Optional[int] = None,
+                 pool: Optional[PagePool] = None,
                  mesh=None, metrics=None):
-        import jax
-
-        self._jax = jax
         self.cfg = cfg
-        self.mesh = mesh
         self.metrics = metrics
-        self.page = int(page)
         self.max_pages = int(max_pages)
         self.budget_bytes = int(budget_bytes)
-        self.page_bytes = self._page_bytes(cfg, self.page)
-        self.num_pages = (int(num_pages) if num_pages is not None
-                          else max(1, self.budget_bytes // self.page_bytes))
+        if pool is not None:
+            if pool.page != int(page):
+                raise ValueError(
+                    f"prefix page ({page}) must equal the shared pool's "
+                    f"page ({pool.page})")
+            self.owns_pool = False
+            self._pool = pool
+        else:
+            self.owns_pool = True
+            self._pool = PagePool(cfg, page=page, num_pages=num_pages,
+                                  budget_bytes=self.budget_bytes, mesh=mesh)
+        self.page = self._pool.page
+        self.page_bytes = self._pool.page_bytes
         # cumulative counters (survive reset(): the store's history, not
         # its contents)
         self.hits = 0
@@ -99,62 +112,48 @@ class PrefixStore:
         self.misses = 0
         self.tokens_saved = 0
         self.inserts = 0
+        self.adoptions = 0
         self.evictions = 0
         self.publishes = 0
         self._publish_fns: Dict[Tuple[int, int], Any] = {}
         self._clock = 0
         self._root: Optional[_PageNode] = None
         self._nodes: List[_PageNode] = []
-        self._free: List[int] = []
-        self.pool: Dict[str, Any] = {}
         self.reset()
 
     @staticmethod
     def _page_bytes(cfg, page: int) -> int:
         """HBM bytes one page occupies across every cache leaf."""
-        import jax.numpy as jnp
+        return PagePool._page_bytes(cfg, page)
 
-        kv = cfg.n_layers * page * cfg.n_kv_heads * cfg.head_dim
-        if cfg.kv_int8:
-            scales = cfg.n_layers * page * cfg.n_kv_heads * 4
-            return 2 * (kv + scales)          # int8 k+v, f32 ks+vs
-        return 2 * kv * jnp.dtype(cfg.dtype).itemsize
+    @property
+    def num_pages(self) -> int:
+        return self._pool.num_pages
 
-    # -- device pool --------------------------------------------------------
-    def _init_pool(self) -> None:
-        import jax.numpy as jnp
+    @num_pages.setter
+    def num_pages(self, n: int) -> None:
+        # takes effect at the next reset() (tests shrink owned pools)
+        self._pool.num_pages = int(n)
 
-        cfg = self.cfg
-        shape = (cfg.n_layers, self.num_pages, self.page, cfg.n_kv_heads,
-                 cfg.head_dim)
-        if cfg.kv_int8:
-            pool = {"k": jnp.zeros(shape, jnp.int8),
-                    "v": jnp.zeros(shape, jnp.int8),
-                    "ks": jnp.ones(shape[:-1], jnp.float32),
-                    "vs": jnp.ones(shape[:-1], jnp.float32)}
-        else:
-            pool = {"k": jnp.zeros(shape, cfg.dtype),
-                    "v": jnp.zeros(shape, cfg.dtype)}
-        if self.mesh is not None:
-            from gofr_tpu.parallel.sharding import (
-                llama_prefix_pool_specs, prune_specs, shard_pytree)
-            pool = shard_pytree(
-                pool, self.mesh,
-                prune_specs(llama_prefix_pool_specs(kv_int8=cfg.kv_int8),
-                            self.mesh))
-        else:
-            pool = self._jax.device_put(pool)
-        self.pool = pool
+    @property
+    def pool(self) -> Dict[str, Any]:
+        """Device pool leaves — what suffix-prefill executables gather."""
+        return self._pool.leaves
+
+    @property
+    def page_pool(self) -> PagePool:
+        return self._pool
 
     def reset(self) -> None:
-        """Drop every cached prefix and rebuild the pool with fresh device
+        """Drop every cached prefix; an owned pool also gets fresh device
         buffers. Called at engine device-state reset: a failed executable
         may have poisoned any in-flight handle, and the index must not
-        advertise pages whose contents are gone."""
+        advertise pages whose contents are gone. With a shared pool the
+        *engine* resets the pool (it owns the other page references)."""
         self._root = _PageNode((), None, -1)  # type: ignore[arg-type]
         self._nodes = []
-        self._free = list(range(self.num_pages))
-        self._init_pool()
+        if self.owns_pool:
+            self._pool.reset()
         self._set_occupancy()
 
     # -- host index ---------------------------------------------------------
@@ -216,9 +215,12 @@ class PrefixStore:
         for node in nodes:
             node.refs = max(0, node.refs - 1)
 
-    def _evict_one(self) -> Optional[int]:
-        """Free the LRU unpinned leaf's page. None when everything is
-        pinned (the caller publishes fewer pages — never blocks)."""
+    def evict_one(self) -> bool:
+        """Evict the LRU unpinned leaf, releasing its page to the pool.
+        False when everything is pinned or the trie is empty — callers
+        (pool ``reclaim``) never block on it. The engine hands this to
+        ``PagePool.alloc`` so decode-growth shortages reclaim cold
+        prefixes before stalling a slot."""
         victim: Optional[_PageNode] = None
         for node in self._nodes:
             if node.children or node.refs > 0:
@@ -226,16 +228,17 @@ class PrefixStore:
             if victim is None or node.last_used < victim.last_used:
                 victim = node
         if victim is None:
-            return None
+            return False
         del victim.parent.children[victim.key]
         self._nodes.remove(victim)
         self.evictions += 1
-        return victim.page_id
+        self._pool.release([victim.page_id])
+        self._set_occupancy()
+        return True
 
     def _alloc_page(self) -> Optional[int]:
-        if self._free:
-            return self._free.pop()
-        return self._evict_one()
+        ids = self._pool.alloc(1, reclaim=self.evict_one)
+        return None if ids is None else ids[0]
 
     def insert(self, tokens: Sequence[int],
                want_pages: int) -> List[Tuple[int, bool]]:
@@ -265,7 +268,36 @@ class PrefixStore:
         self._set_occupancy()
         return out
 
-    # -- device publish -----------------------------------------------------
+    def register(self, tokens: Sequence[int],
+                 page_ids: Sequence[int]) -> List[_PageNode]:
+        """Adopt slot-written pages into the trie with **no KV copy** —
+        the paged engine's publish path. ``page_ids[i]`` already holds
+        the device KV of ``tokens[i*page:(i+1)*page]`` (written by the
+        slot's prefill insert); the trie takes one extra pool ref per
+        adopted page, so it outlives the slot. Pages whose token chain is
+        already cached are skipped (the slot keeps its private copy —
+        both hold identical KV, since K/V at position i depends only on
+        tokens <= i). Returns the full chain walked, for pinning."""
+        chain: List[_PageNode] = []
+        node = self._root
+        for i in range(min(len(page_ids), self.max_pages)):
+            key = tuple(tokens[i * self.page:(i + 1) * self.page])
+            child = node.children.get(key)
+            if child is None:
+                pid = int(page_ids[i])
+                self._pool.retain([pid])
+                child = _PageNode(key, node, pid)
+                node.children[key] = child
+                self._nodes.append(child)
+                self.inserts += 1
+                self.adoptions += 1
+            self._touch(child)
+            chain.append(child)
+            node = child
+        self._set_occupancy()
+        return chain
+
+    # -- device publish (dense engine path only) ----------------------------
     def publish_ready(self, nb: int, lb: int) -> bool:
         return (nb, lb) in self._publish_fns
 
@@ -302,14 +334,18 @@ class PrefixStore:
         ``num_pages`` marking don't-write entries."""
         import jax.numpy as jnp
 
-        self.pool = self._publish_fn(nb, lb)(
-            self.pool, small, jnp.asarray(flat_ids))
+        self._pool.leaves = self._publish_fn(nb, lb)(
+            self._pool.leaves, small, jnp.asarray(flat_ids))
         self.publishes += 1
+        self._pool.note_writes(
+            sum(1 for pid in flat_ids if pid != self._pool.sentinel))
 
     # -- introspection ------------------------------------------------------
     @property
     def used_pages(self) -> int:
-        return self.num_pages - len(self._free)
+        """Pages the *trie* holds (on a shared pool this is a subset of
+        the pool's used pages)."""
+        return len(self._nodes)
 
     def _set_occupancy(self) -> None:
         if self.metrics is not None and self.num_pages:
@@ -326,12 +362,14 @@ class PrefixStore:
             "budget_bytes": self.budget_bytes,
             "page_bytes": self.page_bytes,
             "pool_bytes": self.num_pages * self.page_bytes,
+            "shared_pool": not self.owns_pool,
             "occupancy": (round(self.used_pages / self.num_pages, 6)
                           if self.num_pages else 0.0),
             "lookups": {"total": lookups, "hit": self.hits,
                         "partial": self.partial_hits, "miss": self.misses},
             "tokens_saved": self.tokens_saved,
             "inserts": self.inserts,
+            "adoptions": self.adoptions,
             "evictions": self.evictions,
             "publishes": self.publishes,
         }

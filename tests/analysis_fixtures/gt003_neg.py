@@ -36,3 +36,13 @@ def bucketed(params, tokens):
 
 def tuple_static(params):
     return static_jitted(params, (1, 2, 3))
+
+
+_PAGE_WIDTHS = (4, 8, 16)
+
+
+def ladder_width_upload(table, pages):
+    # disciplined: the slice bound is a ladder rung covering the live
+    # count, so the executable set is bounded by the ladder
+    pw = next(w for w in _PAGE_WIDTHS if w >= len(pages))
+    return jnp.asarray(table[:, :pw])

@@ -33,3 +33,14 @@ def shape_flow(params, tokens):
 def raw_alloc(batch):
     # unbucketed device shape: one executable per distinct request size
     return jnp.zeros((len(batch), 128))
+
+
+def live_width_upload(table, pages):
+    # page-width: the slice bound tracks a live count, so the uploaded
+    # array's shape (and every consumer's executable) changes per value
+    return jnp.asarray(table[:, :len(pages)])
+
+
+def live_width_call(params, table, pages):
+    # page-width at a jitted call site: same hazard, caught at the call
+    return plain_jitted(params, table[:, :len(pages)])

@@ -84,13 +84,28 @@ def test_gt002_negative_observed_spawns_are_clean():
 
 # -- GT003 recompile hazards -------------------------------------------------
 
-def test_gt003_positive_flags_all_four_hazards():
+def test_gt003_positive_flags_all_five_hazards():
     report = scan("gt003_pos.py", "GT003")
     got = keys(report)
     assert "fresh-jit in per_call" in got
     assert "unhashable-static arg1 of static_jitted" in got
     assert "shape-arg arg1 of plain_jitted" in got
     assert "raw-shape in raw_alloc" in got
+    assert "page-width in live_width_upload" in got
+    assert "page-width arg1 of plain_jitted" in got
+
+
+def test_gt003_page_width_is_an_error_and_not_double_reported():
+    """The slice-bound case is the precise ERROR finding; the generic
+    shape-arg warning must not also fire for the same argument."""
+    report = scan("gt003_pos.py", "GT003")
+    by_key = {f.key: f for f in report.new_findings}
+    assert by_key["page-width arg1 of plain_jitted"].severity == "error"
+    assert by_key["page-width in live_width_upload"].severity == "error"
+    shape_args = [f for f in report.new_findings
+                  if f.key.startswith("shape-arg")]
+    assert all(f.line != by_key["page-width arg1 of plain_jitted"].line
+               for f in shape_args)
 
 
 def test_gt003_shape_arg_is_a_warning():
