@@ -11,6 +11,16 @@ shards params with gofr_tpu.parallel.llama_param_specs (Megatron column/row
 specs) and the KV cache with llama_cache_specs (slots on dp, kv-heads on
 tp); XLA inserts the all-reduces over ICI.
 
+Multi-model serving (ISSUE 7): ``MODELS=big=small>cheap,cheap=tiny,moe=moe``
+registers several named engines behind one ModelRegistry — ``name=preset``
+entries, ``>fallback`` names the model DEGRADED traffic shifts to, the first
+entry is the default. Co-resident llama models share one KV page pool when
+``GENERATE_PAGED_KV=1``. Per-model routes:
+
+POST /v1/{model}/generate and /v1/{model}/generate/stream — same bodies as
+below, routed through the registry (503 when the model and its fallback
+cannot serve).
+
 POST /generate {"prompt": "...", "max_new_tokens": 32,
                 "temperature": 0.8, "top_k": 40, "top_p": 0.95, "seed": 1}
 POST /generate/stream — same body, Server-Sent-Events: one ``data:`` frame
@@ -30,16 +40,22 @@ from gofr_tpu.tokenizer import Tokenizer
 def build_app():
     import jax
 
-    from gofr_tpu.models import llama
-    from gofr_tpu.tpu import GenerationEngine
+    from gofr_tpu.models import llama, moe
+    from gofr_tpu.tpu import (GenerationEngine, ModelRegistry,
+                              ModelUnavailable, PagePool)
+    from gofr_tpu.tpu.sched import parse_class_weights
 
     app = new_app()
-    preset = os.environ.get("LLAMA_PRESET", "small")
-    # LLAMA_KV_INT8=1: halve the KV cache's HBM footprint (capacity for
-    # longer contexts/more slots; measured slower — LlamaConfig.kv_int8)
-    cfg = llama.config(preset, vocab_size=256,  # byte-level vocab
-                       kv_int8=os.environ.get("LLAMA_KV_INT8") == "1")
-    params = llama.init(cfg, jax.random.PRNGKey(0))
+    kv_int8 = os.environ.get("LLAMA_KV_INT8") == "1"
+    paged_kv = os.environ.get("GENERATE_PAGED_KV") == "1"
+    kv_page = int(os.environ.get("GENERATE_KV_PAGE", "32"))
+    # SLO-class weighted-fair scheduling: admission interleaves deadline
+    # classes by weight (docs/tpu/model-serving.md "SLO classes")
+    class_weights = parse_class_weights(os.environ.get("SLO_CLASS_WEIGHTS"))
+    # speculative decode: a cheap draft proposes GENERATE_SPEC_GAMMA
+    # tokens per tick, the target verifies them in one batched forward
+    draft_preset = os.environ.get("GENERATE_DRAFT_MODEL")
+    spec_gamma = int(os.environ.get("GENERATE_SPEC_GAMMA", "4"))
 
     mesh = None
     if app.config.get("TPU_MESH"):
@@ -50,44 +66,104 @@ def build_app():
             axes[axis.strip()] = int(size)
         mesh = make_mesh(axes)
 
+    def model_config(preset):
+        """`moe`/`moe-<preset>` → MoE variant; anything else is a llama
+        preset. Byte-level vocab either way."""
+        if preset == "moe" or preset.startswith("moe-"):
+            base = preset[4:] if preset.startswith("moe-") else "tiny"
+            return moe, moe.config(
+                base=llama.config(base, vocab_size=256, kv_int8=kv_int8))
+        return llama, llama.config(preset, vocab_size=256, kv_int8=kv_int8)
+
+    def make_engine(preset, name, seed, with_draft, page_pool=None):
+        module, cfg = model_config(preset)
+        params = module.init(cfg, jax.random.PRNGKey(seed))
+        draft_cfg = draft_params = None
+        if with_draft and module is llama and draft_preset:
+            draft_cfg = llama.config(draft_preset, vocab_size=256)
+            draft_params = llama.init(draft_cfg, jax.random.PRNGKey(seed + 1))
+        return GenerationEngine(
+            cfg, params, mesh=mesh if module is llama else None,
+            max_slots=int(os.environ.get("GENERATE_SLOTS", "8")),
+            max_len=min(cfg.max_seq_len, 1024),
+            # fused decode steps per host round trip (amortises dispatch;
+            # the adaptive ladder drops back to 1 while admissions wait).
+            # r5 measured K=8 ticks costing less device time than their own
+            # dispatch on a high-latency host — 16 is the safer default, 32
+            # for throughput-first serving (docs/tpu/benchmarking.md)
+            steps_per_tick=int(os.environ.get("STEPS_PER_TICK", "16")),
+            # decode ticks in flight before the oldest fetch must land:
+            # token fetches overlap device compute and each other
+            max_inflight_ticks=int(os.environ.get("INFLIGHT_TICKS", "4")),
+            # prefix KV reuse: shared prompt prefixes (system prompts,
+            # few-shot templates) prefill only their suffix against cached
+            # KV pages; greedy outputs stay token-identical with bf16
+            # caches (docs/tpu/model-serving.md "Prefix KV reuse")
+            prefix_cache=(module is llama
+                          and os.environ.get("GENERATE_PREFIX_CACHE") == "1"),
+            prefix_cache_bytes=int(os.environ.get(
+                "GENERATE_PREFIX_CACHE_BYTES", str(64 << 20))),
+            # unified paged KV: one page pool shared by prefill output, the
+            # prefix cache and decode (MoE serves dense — no paged step)
+            paged_kv=paged_kv and module is llama,
+            kv_page=kv_page,
+            kv_pool_bytes=(int(os.environ["GENERATE_KV_POOL_BYTES"])
+                           if "GENERATE_KV_POOL_BYTES" in os.environ
+                           and page_pool is None else None),
+            page_pool=page_pool,
+            model_module=None if module is llama else module,
+            model_name=name,
+            draft_cfg=draft_cfg, draft_params=draft_params,
+            spec_gamma=spec_gamma,
+            class_weights=class_weights,
+            logger=app.logger, metrics=app.container.metrics,
+            # flight recorder: queue.wait/prefill/decode child spans per
+            # request, engine-step spans with links, /debug/statusz views
+            tracer=app.container.tracer,
+            # SLO accounting: X-Request-Deadline-Ms classification (ok/
+            # violated/expired), windowed TTFT quantiles, goodput vs raw
+            # tokens/s — feeds /debug/varz and the degradation watchdog
+            slo=app.container.slo)
+
     tokenizer = Tokenizer()  # byte-level; swap in a trained vocab via load()
-    engine = GenerationEngine(
-        cfg, params, mesh=mesh,
-        max_slots=int(os.environ.get("GENERATE_SLOTS", "8")),
-        max_len=min(cfg.max_seq_len, 1024),
-        # fused decode steps per host round trip (amortises dispatch; the
-        # adaptive ladder drops back to 1 while admissions are waiting).
-        # r5 measured K=8 ticks costing less device time than their own
-        # dispatch on a high-latency host — 16 is the safer default, 32
-        # for throughput-first serving (docs/tpu/benchmarking.md)
-        steps_per_tick=int(os.environ.get("STEPS_PER_TICK", "16")),
-        # decode ticks in flight before the oldest fetch must land: token
-        # fetches overlap device compute and each other (D2H pipelining)
-        max_inflight_ticks=int(os.environ.get("INFLIGHT_TICKS", "4")),
-        # prefix KV reuse: shared prompt prefixes (system prompts, few-shot
-        # templates) prefill only their suffix against cached KV pages.
-        # Greedy outputs stay token-identical with bf16 caches
-        # (docs/tpu/model-serving.md "Prefix KV reuse")
-        prefix_cache=os.environ.get("GENERATE_PREFIX_CACHE") == "1",
-        prefix_cache_bytes=int(os.environ.get(
-            "GENERATE_PREFIX_CACHE_BYTES", str(64 << 20))),
-        # unified paged KV: one page pool shared by prefill output, the
-        # prefix cache and decode — HBM priced at the live token mix
-        # instead of max_slots*max_len, prefix hits admit with zero KV
-        # copies (docs/tpu/model-serving.md "Unified paged KV")
-        paged_kv=os.environ.get("GENERATE_PAGED_KV") == "1",
-        kv_page=int(os.environ.get("GENERATE_KV_PAGE", "32")),
-        kv_pool_bytes=(int(os.environ["GENERATE_KV_POOL_BYTES"])
-                       if "GENERATE_KV_POOL_BYTES" in os.environ else None),
-        logger=app.logger, metrics=app.container.metrics,
-        # flight recorder: queue.wait/prefill/decode child spans per
-        # request, engine-step spans with links, /debug/statusz timelines
-        tracer=app.container.tracer,
-        # SLO accounting: X-Request-Deadline-Ms classification (ok/
-        # violated/expired), windowed TTFT quantiles, goodput vs raw
-        # tokens/s — feeds /debug/varz and the degradation watchdog
-        slo=app.container.slo)
-    app.container.tpu = engine  # surfaces engine health under /.well-known
+    models_spec = os.environ.get("MODELS", "").strip()
+    registry = None
+    if models_spec:
+        # "name=preset[>fallback]" entries, comma-separated, first=default
+        registry = ModelRegistry(
+            watchdog=getattr(app.container, "watchdog", None),
+            logger=app.logger, metrics=app.container.metrics)
+        parsed = []
+        for part in models_spec.split(","):
+            name, _, rest = part.strip().partition("=")
+            preset, _, fallback = rest.partition(">")
+            parsed.append((name.strip(), (preset or "small").strip(),
+                           fallback.strip() or None))
+        shared_pool = None
+        if paged_kv:
+            # co-resident llama engines share one page pool: page ids are
+            # interchangeable, occupancy is chip-global
+            _, pool_cfg = model_config(parsed[0][1])
+            shared_pool = PagePool(
+                pool_cfg, page=kv_page, mesh=mesh,
+                budget_bytes=int(os.environ.get(
+                    "GENERATE_KV_POOL_BYTES", str(256 << 20))),
+                metrics=app.container.metrics)
+            registry.page_pool = shared_pool
+        for seed, (name, preset, fallback) in enumerate(parsed):
+            module, cfg = model_config(preset)
+            pool = shared_pool if module is llama else None
+            eng = make_engine(preset, name, seed * 2, seed == 0,
+                              page_pool=pool)
+            registry.register(name, eng, fallback=fallback,
+                              default=(seed == 0))
+        engine = registry.engine()     # default model (admin accessor —
+        app.container.tpu = registry   # entries are LOADING until warmup);
+        #                                per-model health/statusz/varz/xlaz
+    else:
+        preset = os.environ.get("LLAMA_PRESET", "small")
+        engine = make_engine(preset, "generate", 0, True)
+        app.container.tpu = engine  # surfaces engine health at /.well-known
     app.enable_statusz()        # live queue/slot/KV-cache/timeline snapshot
     app.enable_varz()           # windowed SLO/goodput/saturation numbers
     app.enable_xlaz()           # compile ledger + prompt-bucket fit view
@@ -96,8 +172,15 @@ def build_app():
     async def warm_engine():
         # precompile the decode ladder + prefill/insert executables before
         # the first request: a cold compile is seconds of request latency
-        await engine.warmup(prompt_counts=(1, engine.max_slots))
-        await engine.start()
+        if registry is not None:
+            for name in registry.models():
+                eng = registry.engine(name)
+                await registry.warmup(
+                    name, prompt_counts=(1, eng.max_slots))
+            await registry.start()
+        else:
+            await engine.warmup(prompt_counts=(1, engine.max_slots))
+            await engine.start()
 
     @app.on_shutdown
     async def log_suggested_ladder():
@@ -117,6 +200,24 @@ def build_app():
     class BadRequest(HTTPError):
         status_code = 400
 
+    class Unavailable(HTTPError):
+        status_code = 503
+
+    def resolve_engine(ctx=None):
+        """Default engine, or the registry route for /v1/{model}/..."""
+        name = ctx.path_param("model") if ctx is not None else None
+        if registry is None:
+            if name:
+                raise BadRequest(
+                    "multi-model routing is off (set MODELS to enable)")
+            return engine
+        try:
+            return registry.route(name or None)
+        except KeyError as exc:
+            raise BadRequest(str(exc)) from exc
+        except ModelUnavailable as exc:
+            raise Unavailable(str(exc)) from exc
+
     def parse_request(data):
         try:
             prompt_ids = tokenizer.encode(data["prompt"])[-512:]
@@ -135,31 +236,34 @@ def build_app():
             raise BadRequest(f"bad field value: {exc}") from exc
         return prompt_ids, max_new, sampling
 
-    async def start_stream(data):
+    async def start_stream(eng, data):
         """Validate + admit eagerly so bad requests fail with a 400 before
         any stream bytes are written."""
         prompt_ids, max_new, sampling = parse_request(data)
         try:
-            return await engine.generate_stream(
+            return await eng.generate_stream(
                 prompt_ids, max_new_tokens=max_new, sampling=sampling)
         except ValueError as exc:
             raise BadRequest(str(exc)) from exc
 
     async def generate(ctx):
-        await engine.start()  # idempotent; binds to the serving loop
+        eng = resolve_engine(ctx)
+        await eng.start()  # idempotent; binds to the serving loop
         prompt_ids, max_new, sampling = parse_request(ctx.bind())
         try:
-            out = await engine.generate(prompt_ids, max_new_tokens=max_new,
-                                        sampling=sampling)
+            out = await eng.generate(prompt_ids, max_new_tokens=max_new,
+                                     sampling=sampling)
         except ValueError as exc:
             raise BadRequest(str(exc)) from exc
         return {"completion": tokenizer.decode(out),
-                "tokens": out, "engine": engine.stats()}
+                "tokens": out, "model": eng.model_name,
+                "engine": eng.stats()}
 
     async def generate_stream(ctx):
         from gofr_tpu.http.response import Stream
-        await engine.start()
-        stream = await start_stream(ctx.bind())
+        eng = resolve_engine(ctx)
+        await eng.start()
+        stream = await start_stream(eng, ctx.bind())
 
         async def frames():
             import json
@@ -179,8 +283,9 @@ def build_app():
         return Stream(frames(), sse=True, on_close=stream.cancel)
 
     async def generate_grpc_stream(ctx):
-        await engine.start()
-        stream = await start_stream(ctx.request.payload)
+        eng = resolve_engine()
+        await eng.start()
+        stream = await start_stream(eng, ctx.request.payload)
 
         async def tokens():
             try:
@@ -194,6 +299,8 @@ def build_app():
 
     app.post("/generate", generate)
     app.post("/generate/stream", generate_stream)
+    app.post("/v1/{model}/generate", generate)
+    app.post("/v1/{model}/generate/stream", generate_stream)
     app.register_grpc_stream("Llama", "generate", generate_grpc_stream)
     return app
 

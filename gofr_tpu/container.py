@@ -227,6 +227,44 @@ class Container:
             "app_tpu_kv_ragged_fill_ratio",
             "live tokens / (pages held x page size) across decoding "
             "slots — how ragged the paged KV actually is")
+        # speculative decode catalog (ISSUE 7): draft-verify acceptance —
+        # goodput comes from accepted draft tokens, so the acceptance rate
+        # and the adaptive gamma it drives are the first dashboards to read
+        metrics.new_updown_counter(
+            "app_tpu_spec_proposed_total",
+            "draft tokens proposed to the target verify step, per model")
+        metrics.new_updown_counter(
+            "app_tpu_spec_accepted_total",
+            "draft tokens the target verify step accepted, per model")
+        metrics.new_gauge(
+            "app_tpu_spec_acceptance_rate",
+            "accepted/proposed draft tokens over the adaptive-gamma window")
+        metrics.new_gauge(
+            "app_tpu_spec_gamma",
+            "speculative draft length: per-tick gamma rung and the "
+            "adaptive cap it is chosen under")
+        # multi-model SLO-class scheduling catalog (ISSUE 7): weighted-fair
+        # admission by deadline class, per-class shed, model lifecycle
+        metrics.new_updown_counter(
+            "app_tpu_sched_tokens_total",
+            "generated tokens per (model, SLO class) — per-class goodput")
+        metrics.new_counter(
+            "app_tpu_sched_shed_total",
+            "admissions shed at overflow, per (model, SLO class) — "
+            "shedding is strictly within-class (newest first) before "
+            "any cross-class impact")
+        metrics.new_gauge(
+            "app_tpu_admission_queue_depth",
+            "admission backlog (pending + overflow) per (model, SLO class)")
+        metrics.new_gauge(
+            "app_tpu_model_state",
+            "registry lifecycle per model: 0 LOADING, 1 WARMING, 2 READY, "
+            "3 DRAINING, 4 UNLOADED")
+        metrics.new_counter(
+            "app_tpu_model_fallback_total",
+            "requests routed to a fallback model (by source model and "
+            "fallback taken) — non-zero means degraded or non-READY "
+            "routing is active")
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",

@@ -37,6 +37,7 @@ from gofr_tpu.ops import (
     prefix_prefill_attention,
     rms_norm,
     rope_table,
+    verify_attention,
 )
 from gofr_tpu.ops.quant import qmm, quantize_kv, quantize_tree
 
@@ -479,6 +480,144 @@ def decode_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
     x = rms_norm(x[:, 0], params["out_norm"], cfg.norm_eps)
     logits = qmm(x, params["lm_head"]).astype(jnp.float32)
     return logits, new_pool, cache_len + 1
+
+
+def verify_step(params: Dict[str, Any], cfg: LlamaConfig,
+                tokens: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+                cache_len: jnp.ndarray, window: Optional[int] = None
+                ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Speculative verify forward: score G tokens per row in ONE step.
+
+    ``tokens`` (B, G) sit at absolute positions ``cache_len + g``; the
+    target model computes logits for every position (judging draft token
+    g+1 at position g, plus the bonus position) while writing the G new
+    KV rows into the cache — exactly G sequential :func:`decode_step`
+    calls fused into one forward, which is the whole speculative-decode
+    bargain: decode is HBM-bandwidth-bound streaming weights + cache, so
+    verifying G tokens costs roughly one step's traffic. G is a *static*
+    ladder rung (the engine's γ family), so shapes stay compile-stable.
+
+    Returns (logits (B, G, V) fp32, cache). ``cache_len`` is NOT
+    advanced here — the caller commits ``a + 1`` of the G+1 candidate
+    tokens after acceptance and advances cache_len itself; rows written
+    past the committed point sit beyond cache_len, are never attended,
+    and are overwritten by the next tick (the same masking argument that
+    lets inactive dense rows scatter garbage). Scatters use
+    ``mode="drop"`` so a near-full row cannot clamp-corrupt its tail.
+    """
+    b, g_len = tokens.shape
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    positions = cache_len[:, None] + jnp.arange(g_len,
+                                                dtype=jnp.int32)[None, :]
+    x = params["tok_emb"][tokens]                        # (B, G, D)
+    batch_idx = jnp.arange(b)
+    int8 = cfg.kv_int8
+    carry_keys = ("k", "v", "ks", "vs") if int8 else ("k", "v")
+
+    def body(carry, layer_and_idx):
+        x = carry[0]
+        caches = carry[1:]
+        layer, idx = layer_and_idx
+        views = [lax.dynamic_index_in_dim(c, idx, 0, keepdims=False)
+                 for c in caches]
+        if window is not None:
+            views = [v[:, :window] for v in views]
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
+        k_scale = views[2] if int8 else None
+        v_scale = views[3] if int8 else None
+        attn = verify_attention(q, views[0], views[1], k, v, cache_len,
+                                k_scale=k_scale, v_scale=v_scale)
+        x = x + qmm(attn.reshape(b, g_len, -1), layer["wo"])
+        h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+        x = x + _ffn(layer, h)
+        if int8:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            new_rows = (kq, vq, ks, vs)
+        else:
+            new_rows = (k, v)
+        caches = tuple(
+            c.at[idx, batch_idx[:, None], positions].set(row, mode="drop")
+            for c, row in zip(caches, new_rows))
+        return (x,) + caches, None
+
+    carry, _ = lax.scan(
+        body, (x,) + tuple(cache[key] for key in carry_keys),
+        (params["layers"], jnp.arange(cfg.n_layers)))
+    x = carry[0]
+    new_cache = dict(zip(carry_keys, carry[1:]))
+    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    logits = qmm(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def verify_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
+                      tokens: jnp.ndarray, pool: Dict[str, jnp.ndarray],
+                      page_table: jnp.ndarray, cache_len: jnp.ndarray,
+                      active: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Paged-pool variant of :func:`verify_step` (unified page pool).
+
+    Same contract; the G new KV rows land at pool positions
+    ``cache_len + g`` through the slot's page-table row. ``active`` (B,)
+    bool routes inactive rows' appends to the sentinel page (dropped) —
+    mandatory here because pool pages are shared and may have been
+    reallocated, exactly as in :func:`decode_step_paged`. The engine
+    guarantees an active row's allocated pages cover
+    ``cache_len + G`` before dispatching a γ=G verify rung.
+    """
+    b, g_len = tokens.shape
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    positions = cache_len[:, None] + jnp.arange(g_len,
+                                                dtype=jnp.int32)[None, :]
+    x = params["tok_emb"][tokens]                        # (B, G, D)
+    int8 = cfg.kv_int8
+    carry_keys = ("k", "v", "ks", "vs") if int8 else ("k", "v")
+    num_pages = pool["k"].shape[1]
+    page = pool["k"].shape[2]
+    # per-position append destinations, hoisted out of the layer scan
+    page_col = positions // page                         # (B, G)
+    page_row = jnp.take_along_axis(page_table, page_col, axis=1,
+                                   mode="clip")
+    dest_row = jnp.where(active[:, None], page_row, num_pages)
+    offset = positions % page
+
+    def body(carry, layer_and_idx):
+        x = carry[0]
+        pools = carry[1:]
+        layer, idx = layer_and_idx
+        planes = [lax.dynamic_index_in_dim(c, idx, 0, keepdims=False)
+                  for c in pools]
+        views = [gather_kv_pages(p, page_table) for p in planes]
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
+        k_scale = views[2] if int8 else None
+        v_scale = views[3] if int8 else None
+        attn = verify_attention(q, views[0], views[1], k, v, cache_len,
+                                k_scale=k_scale, v_scale=v_scale)
+        x = x + qmm(attn.reshape(b, g_len, -1), layer["wo"])
+        h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+        x = x + _ffn(layer, h)
+        if int8:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            new_rows = (kq, vq, ks, vs)
+        else:
+            new_rows = (k, v)
+        pools = tuple(
+            c.at[idx, dest_row, offset].set(row, mode="drop")
+            for c, row in zip(pools, new_rows))
+        return (x,) + pools, None
+
+    carry, _ = lax.scan(
+        body, (x,) + tuple(pool[key] for key in carry_keys),
+        (params["layers"], jnp.arange(cfg.n_layers)))
+    x = carry[0]
+    new_pool = dict(zip(carry_keys, carry[1:]))
+    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    logits = qmm(x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_pool
 
 
 def generate(params: Dict[str, Any], cfg: LlamaConfig, tokens: jnp.ndarray,

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from gofr_tpu.models import llama as llama_mod
-from gofr_tpu.ops import prefill_attention, rms_norm, rope_table
+from gofr_tpu.ops import (decode_attention_cached, prefill_attention,
+                          rms_norm, rope_table)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +39,45 @@ class MoEConfig:
     n_experts: int = 4
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
+
+    # Serving-contract delegation: GenerationEngine and its cache sizing
+    # read these off the config it is handed, so an MoEConfig quacks like
+    # the base LlamaConfig for everything that is not an FFN concern.
+    @property
+    def vocab_size(self) -> int:
+        return self.base.vocab_size
+
+    @property
+    def dim(self) -> int:
+        return self.base.dim
+
+    @property
+    def n_layers(self) -> int:
+        return self.base.n_layers
+
+    @property
+    def n_heads(self) -> int:
+        return self.base.n_heads
+
+    @property
+    def n_kv_heads(self) -> int:
+        return self.base.n_kv_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.base.head_dim
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.base.max_seq_len
+
+    @property
+    def dtype(self):
+        return self.base.dtype
+
+    @property
+    def kv_int8(self) -> bool:
+        return self.base.kv_int8
 
 
 PRESETS = {
@@ -146,6 +186,115 @@ def forward(params: Dict[str, Any], cfg: MoEConfig, tokens: jnp.ndarray
     x = rms_norm(x, params["out_norm"], base.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, aux / base.n_layers
+
+
+# -- serving bridge (llama serving contract: ISSUE 7 registry entry) --------
+#
+# GenerationEngine accepts any model module exposing
+# init_cache/prefill/decode_step with llama's signatures; these mirror
+# llama's dense serving path with ``_moe_ffn`` substituted for the dense
+# FFN (the router aux loss is a training regularizer and is dropped).
+# Deliberately narrower than llama: no paged KV, no prefix reuse, no
+# int8 cache — the engine's custom-module validation already blocks the
+# first two, and the bf16-only guard here keeps the last honest.
+
+
+def _check_serving_cfg(cfg: MoEConfig) -> llama_mod.LlamaConfig:
+    if cfg.base.kv_int8:
+        raise ValueError("MoE serving path is bf16-only (kv_int8=False)")
+    return cfg.base
+
+
+def init_cache(cfg: MoEConfig, batch: int,
+               max_len: Optional[int] = None) -> Dict[str, jnp.ndarray]:
+    """Same static-shape per-layer KV cache as llama (attention is
+    identical; only the FFN differs)."""
+    return llama_mod.init_cache(cfg.base, batch, max_len)
+
+
+def prefill(params: Dict[str, Any], cfg: MoEConfig, tokens: jnp.ndarray,
+            cache: Dict[str, jnp.ndarray],
+            lengths: Optional[jnp.ndarray] = None
+            ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Run the prompt, fill the cache. Returns (last-token logits (B, V),
+    cache, cache_len (B,)) — llama.prefill's bucketed-serving contract
+    (``lengths`` supports right-padded prompts)."""
+    base = _check_serving_cfg(cfg)
+    b, s = tokens.shape
+    cos, sin = rope_table(base.max_seq_len, base.head_dim, base.rope_theta)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = params["tok_emb"][tokens]
+
+    def body(x, xs):
+        layer = xs["layer"]
+        h = rms_norm(x, layer["attn_norm"], base.norm_eps)
+        q, k, v = llama_mod._qkv(layer, h, base, cos, sin, positions)
+        attn = prefill_attention(q, k, v).reshape(b, s, -1)
+        x = x + attn @ layer["wo"]
+        h = rms_norm(x, layer["ffn_norm"], base.norm_eps)
+        ffn_out, _ = _moe_ffn(layer, h, cfg)
+        x = x + ffn_out
+        new_cache = {
+            "k": lax.dynamic_update_slice_in_dim(
+                xs["cache"]["k"], k, 0, axis=1),
+            "v": lax.dynamic_update_slice_in_dim(
+                xs["cache"]["v"], v, 0, axis=1)}
+        return x, new_cache
+
+    x, new_cache = lax.scan(
+        body, x, {"layer": params["layers"], "cache": cache})
+    if lengths is None:
+        last = x[:, -1]
+        cache_len = jnp.full((b,), s, jnp.int32)
+    else:
+        last = x[jnp.arange(b), lengths - 1]
+        cache_len = lengths.astype(jnp.int32)
+    last = rms_norm(last, params["out_norm"], base.norm_eps)
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache, cache_len
+
+
+def decode_step(params: Dict[str, Any], cfg: MoEConfig,
+                token: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+                cache_len: jnp.ndarray, window: Optional[int] = None
+                ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
+    """One decode step: token (B,) → (logits (B, V), cache, cache_len+1).
+    Cache rides the scan carry and the scatter writes only the B new
+    rows, exactly llama.decode_step's layout (its rationale applies
+    unchanged — the FFN swap doesn't touch the KV path)."""
+    base = _check_serving_cfg(cfg)
+    b = token.shape[0]
+    cos, sin = rope_table(base.max_seq_len, base.head_dim, base.rope_theta)
+    positions = cache_len[:, None]                       # (B, 1)
+    x = params["tok_emb"][token][:, None, :]             # (B, 1, D)
+    batch_idx = jnp.arange(b)
+
+    def body(carry, layer_and_idx):
+        x, ck, cv = carry
+        layer, idx = layer_and_idx
+        k_view = lax.dynamic_index_in_dim(ck, idx, 0, keepdims=False)
+        v_view = lax.dynamic_index_in_dim(cv, idx, 0, keepdims=False)
+        if window is not None:
+            k_view = k_view[:, :window]
+            v_view = v_view[:, :window]
+        h = rms_norm(x, layer["attn_norm"], base.norm_eps)
+        q, k, v = llama_mod._qkv(layer, h, base, cos, sin, positions)
+        attn = decode_attention_cached(q, k_view, v_view, k[:, 0],
+                                       v[:, 0], cache_len)
+        x = x + attn.reshape(b, 1, -1) @ layer["wo"]
+        h = rms_norm(x, layer["ffn_norm"], base.norm_eps)
+        ffn_out, _ = _moe_ffn(layer, h, cfg)
+        x = x + ffn_out
+        ck = ck.at[idx, batch_idx, cache_len].set(k[:, 0])
+        cv = cv.at[idx, batch_idx, cache_len].set(v[:, 0])
+        return (x, ck, cv), None
+
+    (x, ck, cv), _ = lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(base.n_layers)))
+    x = rms_norm(x[:, 0], params["out_norm"], base.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}, cache_len + 1
 
 
 def loss_fn(params: Dict[str, Any], cfg: MoEConfig, tokens: jnp.ndarray,

@@ -12,8 +12,10 @@ from gofr_tpu.ops.attention import (
     decode_attention_cached,
     gather_kv_pages,
     paged_decode_attention,
+    paged_verify_attention,
     prefill_attention,
     prefix_prefill_attention,
+    verify_attention,
 )
 from gofr_tpu.ops.norms import layer_norm, rms_norm
 from gofr_tpu.ops.rotary import apply_rope, rope_table
@@ -21,5 +23,6 @@ from gofr_tpu.ops.rotary import apply_rope, rope_table
 __all__ = [
     "attention", "causal_mask", "decode_attention", "prefill_attention",
     "prefix_prefill_attention", "gather_kv_pages", "paged_decode_attention",
+    "verify_attention", "paged_verify_attention",
     "layer_norm", "rms_norm", "apply_rope", "rope_table",
 ]

@@ -143,6 +143,81 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, k_new, v_new,
                                    v_scale=v_scale)
 
 
+def verify_attention(q, k_cache, v_cache, k_new, v_new,
+                     cache_len, k_scale=None,
+                     v_scale=None) -> jnp.ndarray:
+    """Multi-query decode attention for speculative verify (draft-verify
+    decode): G draft tokens per row are judged by the target model in one
+    forward instead of G sequential decode steps.
+
+    Generalizes :func:`decode_attention_cached` from 1 query to G: query
+    ``g`` sits at absolute position ``cache_len + g``, attends every
+    prior cache entry (``t < cache_len[b]``) plus the new tokens' own
+    K/V causally (``u <= g``). The new K/V ride along explicitly for the
+    same reason as the decode path — attending a just-scattered cache
+    lowers poorly — and the caller scatters them afterwards.
+
+    q: (B, G, Hq, D); caches: (B, Tmax, Hkv, D); k_new/v_new:
+    (B, G, Hkv, D); cache_len: (B,) — valid entries *excluding* the G
+    new tokens. int8 caches pass ``k_scale``/``v_scale`` (B, Tmax, Hkv);
+    scale folding mirrors decode_attention_cached exactly (K into f32
+    scores post-einsum, V into f32 probs pre-einsum) so G=1 verify is
+    bit-identical to a decode step. Returns (B, G, Hq, D).
+    """
+    batch, g_len, q_heads, head_dim = q.shape
+    kv_heads = k_cache.shape[2]
+    group = q_heads // kv_heads
+    qg = q.reshape(batch, g_len, kv_heads, group, head_dim)
+
+    scale = head_dim ** -0.5
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg,
+                        k_cache.astype(q.dtype)).astype(jnp.float32) * scale
+    if k_scale is not None:
+        scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    valid = jnp.arange(k_cache.shape[1])[None, None, None, None, :] \
+        < cache_len[:, None, None, None, None]
+    scores = jnp.where(valid, scores, _NEG_INF)
+    # the G new tokens attend each other causally (key u <= query s)
+    scores_new = jnp.einsum("bskgd,bukd->bkgsu", qg,
+                            k_new).astype(jnp.float32) * scale
+    causal = (jnp.arange(g_len)[None, :]
+              <= jnp.arange(g_len)[:, None])            # (S, U)
+    scores_new = jnp.where(causal[None, None, None], scores_new, _NEG_INF)
+    scores = jnp.concatenate([scores, scores_new], axis=-1)  # (B,K,G,S,T+S)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    probs_cache = probs[..., :-g_len]
+    probs_new = probs[..., -g_len:]
+    if v_scale is not None:
+        probs_cache = probs_cache \
+            * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
+        out = jnp.einsum("bkgst,btkd->bskgd", probs_cache,
+                         v_cache.astype(jnp.float32)).astype(q.dtype)
+    else:
+        out = jnp.einsum("bkgst,btkd->bskgd", probs_cache.astype(q.dtype),
+                         v_cache.astype(q.dtype))
+    out = out + jnp.einsum("bkgsu,bukd->bskgd", probs_new.astype(q.dtype),
+                           v_new)
+    return out.reshape(batch, g_len, q_heads, head_dim)
+
+
+def paged_verify_attention(q, k_pages, v_pages, page_table, k_new, v_new,
+                           cache_len, k_scale_pages=None,
+                           v_scale_pages=None) -> jnp.ndarray:
+    """Paged variant of :func:`verify_attention`: gathers the slot's KV
+    view out of the shared page pool (same formulation as
+    :func:`paged_decode_attention`) and delegates, so the paged verify is
+    token-identical to the dense verify by construction."""
+    k_cache = gather_kv_pages(k_pages, page_table)
+    v_cache = gather_kv_pages(v_pages, page_table)
+    k_scale = (gather_kv_pages(k_scale_pages, page_table)
+               if k_scale_pages is not None else None)
+    v_scale = (gather_kv_pages(v_scale_pages, page_table)
+               if v_scale_pages is not None else None)
+    return verify_attention(q, k_cache, v_cache, k_new, v_new,
+                            cache_len, k_scale=k_scale, v_scale=v_scale)
+
+
 def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
                             cache_len, k_scale=None,
                             v_scale=None) -> jnp.ndarray:

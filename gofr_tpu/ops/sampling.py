@@ -64,6 +64,136 @@ def sample_logits(logits: jnp.ndarray, temperature: jnp.ndarray,
     return jnp.where(temperature > 0.0, sampled, greedy)
 
 
+def filtered_log_probs(logits: jnp.ndarray, temperature: jnp.ndarray,
+                       top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Log-probs of the distribution :func:`sample_logits` draws from.
+
+    Replicates the exact masking math above — descending sort,
+    temperature scaling with the same floor, rank-based top-k, nucleus
+    prefix that always keeps the argmax — then log-softmaxes the masked
+    scaled logits and scatters back to vocab order. ``categorical`` over
+    the masked logits samples from exp of exactly this array, which is
+    what makes speculative rejection sampling distribution-preserving:
+    both draft proposal probs and target acceptance probs come from this
+    one definition. Returns (V,) f32; filtered-out tokens are ``-inf``.
+    """
+    vocab = logits.shape[-1]
+    order = jnp.argsort(-logits)
+    sorted_logits = jnp.take(logits, order)
+    temp = jnp.maximum(temperature, _TEMP_FLOOR)
+    scaled = sorted_logits.astype(jnp.float32) / temp
+
+    ranks = jnp.arange(vocab, dtype=jnp.int32)
+    k_eff = jnp.where(top_k > 0, top_k, vocab)
+    keep_k = ranks < k_eff
+    probs = jax.nn.softmax(scaled, axis=-1)
+    mass_before = jnp.cumsum(probs) - probs
+    keep_p = mass_before < top_p
+
+    masked = jnp.where(keep_k & keep_p, scaled, -jnp.inf)
+    logp_sorted = jax.nn.log_softmax(masked, axis=-1)
+    return jnp.zeros((vocab,), jnp.float32).at[order].set(logp_sorted)
+
+
+def filtered_log_probs_batch(logits: jnp.ndarray, temperature: jnp.ndarray,
+                             top_k: jnp.ndarray,
+                             top_p: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise :func:`filtered_log_probs`: (B, V) logits → (B, V)."""
+    return jax.vmap(filtered_log_probs)(logits, temperature, top_k, top_p)
+
+
+# Residual distributions with less mass than this fall back to the plain
+# target distribution (the residual is numerically all-zero only when
+# draft and target agree almost exactly, where the fallback is harmless).
+_RESIDUAL_FLOOR = 1e-9
+
+
+def _speculative_accept_row(t_logits: jnp.ndarray, q_logp: jnp.ndarray,
+                            draft_tokens: jnp.ndarray,
+                            temperature: jnp.ndarray, top_k: jnp.ndarray,
+                            top_p: jnp.ndarray, key: jax.Array):
+    """Accept/reject one row's G draft tokens against G+1 target logits.
+
+    t_logits: (G+1, V) raw target logits — position ``i < G`` judges
+    ``draft_tokens[i]``, position G scores the bonus token; q_logp:
+    (G, V) the draft's *filtered* log-probs (what the draft sampled
+    from); returns ``(out_tokens (G+1,), accept_count, carry_key)``.
+
+    Greedy rows (temperature <= 0) accept the longest prefix where the
+    target argmax equals the draft token; the emitted stream is the
+    target argmax at every position, so greedy speculative decode is
+    token-identical to target-only greedy by construction. Sampled rows
+    run standard rejection sampling: accept ``d_i`` with prob
+    ``min(1, p(d_i)/q(d_i))``; on rejection resample from the residual
+    ``normalize(max(p - q, 0))``; if all G are accepted, a bonus token
+    is drawn from the target's own filtered distribution at position G.
+    Either way position ``accept_count`` holds the one extra committed
+    token, so a row always commits ``accept_count + 1`` tokens per tick.
+    """
+    g_len = draft_tokens.shape[0]
+    k_u, k_res, k_bonus, carry = jax.random.split(key, 4)
+
+    # -- greedy branch: argmax-prefix matching ------------------------------
+    t_argmax = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # (G+1,)
+    greedy_match = t_argmax[:g_len] == draft_tokens
+    greedy_accept = jnp.sum(jnp.cumprod(
+        greedy_match.astype(jnp.int32)))
+    # accepted positions equal the argmax, so the argmax stream IS the
+    # output (correction at the first mismatch, bonus at G — same array)
+    greedy_out = t_argmax
+
+    # -- sampled branch: rejection sampling ---------------------------------
+    p_logp = jax.vmap(filtered_log_probs, in_axes=(0, None, None, None))(
+        t_logits, temperature, top_k, top_p)                     # (G+1, V)
+    pos = jnp.arange(g_len)
+    p_d = p_logp[pos, draft_tokens]
+    q_d = q_logp[pos, draft_tokens]
+    u = jax.random.uniform(k_u, (g_len,))
+    accept = u < jnp.exp(p_d - q_d)            # ratio > 1 always accepts
+    accept_count = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+
+    # residual distribution per position: normalize(max(p - q, 0)); when
+    # the residual mass underflows (draft ≈ target) fall back to p itself
+    p_probs = jnp.exp(p_logp[:g_len])
+    residual = jnp.maximum(p_probs - jnp.exp(q_logp), 0.0)
+    res_mass = residual.sum(axis=-1, keepdims=True)
+    res_logits = jnp.where(residual > 0.0, jnp.log(
+        jnp.maximum(residual, _RESIDUAL_FLOOR)), -jnp.inf)
+    res_logits = jnp.where(res_mass > _RESIDUAL_FLOOR,
+                           res_logits, p_logp[:g_len])
+    corrections = jax.vmap(jax.random.categorical)(
+        jax.random.split(k_res, g_len), res_logits).astype(jnp.int32)
+    bonus = jax.random.categorical(k_bonus, p_logp[g_len]).astype(jnp.int32)
+    replacements = jnp.concatenate([corrections, bonus[None]])   # (G+1,)
+    padded_draft = jnp.concatenate(
+        [draft_tokens, jnp.zeros((1,), jnp.int32)])
+    sampled_out = jnp.where(jnp.arange(g_len + 1) < accept_count,
+                            padded_draft, replacements)
+
+    greedy_row = temperature <= 0.0
+    out = jnp.where(greedy_row, greedy_out, sampled_out)
+    count = jnp.where(greedy_row, greedy_accept, accept_count)
+    return out, count.astype(jnp.int32), carry
+
+
+def speculative_accept(t_logits: jnp.ndarray, q_logp: jnp.ndarray,
+                       draft_tokens: jnp.ndarray, temperature: jnp.ndarray,
+                       top_k: jnp.ndarray, top_p: jnp.ndarray,
+                       keys: jax.Array):
+    """Batched draft-verify acceptance (speculative decode).
+
+    t_logits (B, G+1, V) raw target logits; q_logp (B, G, V) draft
+    filtered log-probs; draft_tokens (B, G); per-row sampling state as in
+    :func:`sample_batch`; keys (B, 2). Returns ``(out_tokens (B, G+1),
+    accept_counts (B,), carry_keys (B, 2))`` — row ``b`` commits
+    ``out_tokens[b, :accept_counts[b] + 1]``. Keys are consumed once per
+    row per tick regardless of acceptance, so a slot's stream stays a
+    pure function of its seed and its committed-token history.
+    """
+    return jax.vmap(_speculative_accept_row)(
+        t_logits, q_logp, draft_tokens, temperature, top_k, top_p, keys)
+
+
 def sample_batch(logits: jnp.ndarray, temperature: jnp.ndarray,
                  top_k: jnp.ndarray, top_p: jnp.ndarray,
                  keys: jax.Array) -> Tuple[jnp.ndarray, jax.Array]:

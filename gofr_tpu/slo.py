@@ -106,6 +106,8 @@ class SLOTracker:
     def __init__(self, metrics: Any = None, slice_s: float = 5.0,
                  max_window_s: float = 300.0):
         self.metrics = metrics
+        self._slice_s = slice_s
+        self._max_window_s = max_window_s
         self.ttft = WindowedDigest(alpha=0.01, slice_s=slice_s,
                                    max_window_s=max_window_s)
         self.tokens = WindowedCounter(slice_s, max_window_s)
@@ -114,6 +116,10 @@ class SLOTracker:
             name: WindowedCounter(slice_s, max_window_s)
             for name in TERMINAL_OUTCOMES
         }
+        # per-SLO-class views, built lazily on first labelled event so a
+        # single-tenant deployment pays nothing for the multi-class path
+        self.class_outcomes: Dict[tuple, WindowedCounter] = {}
+        self.class_goodput: Dict[str, WindowedCounter] = {}
 
     # -- event feeds --------------------------------------------------------
     def record_ttft(self, seconds: float, now: Optional[float] = None) -> None:
@@ -131,16 +137,32 @@ class SLOTracker:
         return OUTCOME_OK if finished_at <= deadline else OUTCOME_VIOLATED
 
     def record_outcome(self, outcome: str, tokens: float = 0.0,
-                       now: Optional[float] = None) -> None:
+                       now: Optional[float] = None,
+                       cls: Optional[str] = None) -> None:
         """One request reached a terminal state. ``tokens`` is the
         request's total generated tokens; only ``ok`` completions count
-        toward goodput."""
+        toward goodput. ``cls`` (SLO class from ``tpu.sched``) adds the
+        event to the per-class views used by weighted-fair scheduling
+        dashboards — omitted, the event stays aggregate-only."""
         counter = self.outcomes.get(outcome)
         if counter is None:
             return
         counter.add(1.0, now=now)
         if outcome == OUTCOME_OK and tokens > 0:
             self.goodput_tokens.add(tokens, now=now)
+        if cls is not None:
+            key = (cls, outcome)
+            per_class = self.class_outcomes.get(key)
+            if per_class is None:
+                per_class = self.class_outcomes[key] = WindowedCounter(
+                    self._slice_s, self._max_window_s)
+            per_class.add(1.0, now=now)
+            if outcome == OUTCOME_OK and tokens > 0:
+                goodput = self.class_goodput.get(cls)
+                if goodput is None:
+                    goodput = self.class_goodput[cls] = WindowedCounter(
+                        self._slice_s, self._max_window_s)
+                goodput.add(tokens, now=now)
         if self.metrics is not None:
             self.metrics.increment_counter("app_tpu_slo_total", outcome=outcome)
 
@@ -196,6 +218,16 @@ class SLOTracker:
             "tokens_total": self.tokens.total(),
             "goodput_tokens_total": self.goodput_tokens.total(),
         }
+        if self.class_outcomes or self.class_goodput:
+            classes: Dict[str, Any] = {}
+            for (cls, outcome), counter in sorted(self.class_outcomes.items()):
+                entry = classes.setdefault(cls, {"outcomes_60s": {}})
+                entry["outcomes_60s"][outcome] = counter.sum(60.0, now)
+            for cls, counter in self.class_goodput.items():
+                entry = classes.setdefault(cls, {"outcomes_60s": {}})
+                entry["goodput_tokens_per_s_60s"] = round(
+                    counter.rate(60.0, now), 3)
+            out["classes"] = classes
         return out
 
 

@@ -10,9 +10,12 @@ from gofr_tpu.tpu.compile_ledger import (CAUSE_SERVING, CAUSE_WARMUP,
 from gofr_tpu.tpu.executor import DEFAULT_BUCKETS, Executor, new_executor
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.tpu.generate import GenerationEngine
+from gofr_tpu.tpu.page_pool import HBMBudget, PagePool
+from gofr_tpu.tpu.registry import ModelRegistry, ModelUnavailable
 
 __all__ = ["DynamicBatcher", "Executor", "FlightRecorder",
            "GenerationEngine", "RequestRecord", "new_executor",
            "DEFAULT_BUCKETS", "CompileLedger", "ShapeStats",
            "CAUSE_WARMUP", "CAUSE_SERVING", "fingerprint_lowered",
-           "suggest_ladder"]
+           "suggest_ladder", "ModelRegistry", "ModelUnavailable",
+           "PagePool", "HBMBudget"]

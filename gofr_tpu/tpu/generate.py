@@ -60,9 +60,19 @@ from gofr_tpu.aio import spawn_logged
 from gofr_tpu.slo import DeadlineExceeded, current_deadline
 from gofr_tpu.tpu.compile_ledger import ShapeStats, suggest_ladder
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
+from gofr_tpu.tpu.sched import (ClassQueues, DEFAULT_CLASS_WEIGHTS,
+                                deadline_class)
 from gofr_tpu.trace import Span, current_span
 
 DEFAULT_PROMPT_BUCKETS = (32, 128, 512)
+
+# adaptive-γ controller (speculative decode): windowed acceptance is
+# evaluated every N spec ticks; below the shrink threshold the γ cap
+# halves (a diverging draft wastes the whole verify forward), above the
+# grow threshold it climbs back toward the configured γ
+_SPEC_WINDOW_TICKS = 16
+_SPEC_SHRINK_BELOW = 0.5
+_SPEC_GROW_ABOVE = 0.8
 
 # sentinel pushed onto a streaming queue when the request completes
 _DONE = object()
@@ -165,11 +175,14 @@ class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
                  "inflight", "queue", "temperature", "fill", "submitted_at",
                  "deadline", "record", "req_span", "phase_span", "pages",
-                 "nodes")
+                 "nodes", "cls", "spec_proposed", "spec_accepted")
 
     def __init__(self):
         self.pages: List[int] = []   # paged KV: pool pages this slot owns
         self.nodes: List[Any] = []   # paged KV: pinned prefix-trie nodes
+        self.cls = "batch"           # SLO class (tpu.sched.deadline_class)
+        self.spec_proposed = 0       # speculative decode: draft tokens
+        self.spec_accepted = 0       # ... and how many the target kept
         self.future: Optional[asyncio.Future] = None
         self.submitted_at = 0.0    # request submit time → TTFT histogram
         self.deadline: Optional[float] = None  # abs monotonic SLO deadline
@@ -191,9 +204,11 @@ class _Slot:
 
 class _Fetch:
     """One dispatched device op whose tokens are being fetched to host in a
-    worker thread. ``kind`` is "prefill" (payload: [(slot, gen, row)]) or
-    "tick" (payload: [(slot, gen)]). ``span`` is the open engine-step span
-    (dispatch → publish), finished when the fetch lands."""
+    worker thread. ``kind`` is "prefill" (payload: [(slot, gen, row)]),
+    "tick" (payload: [(slot, gen)]), or "spec" (payload: ([(slot, gen)],
+    gamma); the fetch lands (tokens, accept_counts)). ``span`` is the open
+    engine-step span (dispatch → publish), finished when the fetch
+    lands."""
     __slots__ = ("task", "kind", "payload", "span")
 
     def __init__(self, task, kind: str, payload,
@@ -220,6 +235,12 @@ class GenerationEngine:
                  kv_pages: Optional[int] = None,
                  kv_pool_bytes: Optional[int] = None,
                  kv_page_reserve: Optional[int] = None,
+                 page_pool=None,
+                 model_module=None,
+                 model_name: str = "generate",
+                 draft_cfg=None, draft_params=None,
+                 spec_gamma: int = 4,
+                 class_weights: Optional[Dict[str, float]] = None,
                  logger=None, metrics=None, tracer=None, recorder=None,
                  slo=None):
         import jax
@@ -229,7 +250,33 @@ class GenerationEngine:
 
         self._jax = jax
         self._jnp = jnp
-        self._llama = llama
+        # the served model module: llama by default; anything exposing the
+        # llama serving contract (init_cache/prefill/decode_step with a
+        # compatible Config) plugs in — models/moe.py is the first taker
+        self._llama = llama if model_module is None else model_module
+        self.model_name = str(model_name)
+        if model_module is not None and model_module is not llama:
+            missing = [name for name in ("init_cache", "prefill",
+                                         "decode_step")
+                       if not hasattr(model_module, name)]
+            if missing:
+                raise ValueError(
+                    f"model_module lacks serving entry points {missing}")
+            if mesh is not None:
+                raise ValueError(
+                    "model_module: sharding specs are llama-specific; "
+                    "custom model modules serve unsharded (mesh=None)")
+            if paged_kv and not hasattr(model_module, "decode_step_paged"):
+                raise ValueError(
+                    "paged_kv requires the model module to implement "
+                    "decode_step_paged")
+            if prefix_cache:
+                raise ValueError(
+                    "prefix_cache requires the llama model module")
+            if draft_cfg is not None:
+                raise ValueError(
+                    "speculative decode requires the llama model module "
+                    "(the target verify step)")
         self.cfg = cfg
         self.mesh = mesh
         if mesh is not None and "dp" in mesh.shape:
@@ -327,7 +374,23 @@ class GenerationEngine:
         if self.paged:
             from gofr_tpu.tpu.page_pool import PagePool
             self.pages_per_slot = self.max_len // self.kv_page
-            if kv_pages is not None:
+            if page_pool is not None:
+                # multi-model tenancy: co-resident engines with the same
+                # KV geometry address one literal pool instance — page
+                # ids are interchangeable, occupancy is chip-global
+                if page_pool.page != self.kv_page:
+                    raise ValueError(
+                        f"shared page_pool page size {page_pool.page} != "
+                        f"engine kv_page {self.kv_page}")
+                if PagePool._page_bytes(cfg, self.kv_page) \
+                        != page_pool.page_bytes:
+                    raise ValueError(
+                        "shared page_pool KV geometry does not match this "
+                        "engine's config (layers/kv-heads/head-dim/dtype "
+                        "must agree; heterogeneous models need their own "
+                        "pools carved from an HBMBudget)")
+                self._pool = page_pool
+            elif kv_pages is not None:
                 self._pool = PagePool(cfg, page=self.kv_page,
                                       num_pages=int(kv_pages), mesh=mesh,
                                       metrics=metrics)
@@ -356,6 +419,11 @@ class GenerationEngine:
             self._table_version = 0
             self._table_cache: Dict[int, Tuple[int, Any]] = {}
             self._page_stalls = 0
+            # shared-pool reset fan-out: when a co-resident engine rebuilds
+            # the pool, this engine's page ids dangle — _on_pool_reset
+            # fails outstanding work and re-sentinels the table
+            self._in_pool_reset = False
+            self._pool.subscribe(self._on_pool_reset)
         elif mesh is not None:
             from gofr_tpu.parallel.sharding import (  # noqa: F811
                 llama_cache_specs, prune_specs, shard_pytree)
@@ -375,9 +443,52 @@ class GenerationEngine:
         self.top_ps = jnp.ones((max_slots,), jnp.float32)
         self.sample_keys = jnp.zeros((max_slots, 2), jnp.uint32)
 
+        # -- speculative draft-verify decode (ISSUE 7) -----------------------
+        self.spec = draft_cfg is not None and draft_params is not None
+        self.spec_gamma = max(1, int(spec_gamma))
+        self.draft_cfg = draft_cfg
+        self.draft_params = None
+        self._draft_cache = None
+        self._g_ladder: List[int] = []
+        if self.spec:
+            if mesh is not None:
+                raise ValueError(
+                    "speculative decode does not compose with a mesh yet "
+                    "(the draft has no sharding specs)")
+            if getattr(draft_cfg, "vocab_size", None) != cfg.vocab_size:
+                raise ValueError(
+                    "draft and target models must share a vocabulary "
+                    f"({getattr(draft_cfg, 'vocab_size', None)} vs "
+                    f"{cfg.vocab_size})")
+            self.draft_params = jax.device_put(draft_params)
+            # the draft cache is always dense: the draft is small, and a
+            # dense (max_slots, max_len) row per slot keeps draft decode
+            # independent of the target's paging scheme. Both models share
+            # one cache_len — the draft always prefills the full prompt,
+            # so their committed lengths never diverge.
+            self._draft_cache = jax.device_put(
+                llama.init_cache(draft_cfg, max_slots, self.max_len))
+            self._g_ladder = [1]
+            while self._g_ladder[-1] * 2 <= self.spec_gamma:
+                self._g_ladder.append(self._g_ladder[-1] * 2)
+            if self._g_ladder[-1] != self.spec_gamma:
+                self._g_ladder.append(self.spec_gamma)
+        self._gamma_cap = self.spec_gamma if self.spec else 0
+        self._spec_ticks = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_window_proposed = 0
+        self._spec_window_accepted = 0
+
         self._slots = [_Slot() for _ in range(max_slots)]
         self._free: List[int] = list(range(max_slots))
-        self._pending: asyncio.Queue = asyncio.Queue()
+        # SLO-class weighted-fair admission (ISSUE 7): the pending queue
+        # pops by per-class virtual time, so interactive traffic drains
+        # ahead of batch in proportion to its weight — the per-class tick
+        # budget falls out of admission (every admitted slot rides every
+        # tick), so WFQ at this gate IS the tick-share mechanism
+        self.class_weights = dict(class_weights or DEFAULT_CLASS_WEIGHTS)
+        self._pending: ClassQueues = ClassQueues(self.class_weights)
         self._task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._steps = 0
@@ -385,8 +496,12 @@ class GenerationEngine:
         self.max_inflight_ticks = max(1, int(max_inflight_ticks))
         self._publishq: "deque" = deque()   # FIFO of _Fetch entries
         # page-gated admissions (paged path): requests that fit a slot but
-        # not the pool's free pages wait here, FIFO ahead of _pending
+        # not the pool's free pages wait here, FIFO ahead of _pending.
+        # Bounded: past the cap the deepest class sheds its own newest
+        # entry first (strictly within class before cross-class)
         self._overflow: "deque" = deque()
+        self._overflow_cap = max(16, 4 * max_slots)
+        self._shed_by_class: Dict[str, int] = {}
         self._ticks_inflight = 0
         self._cancelled_queues: set = set()  # ids of abandoned stream queues
 
@@ -404,6 +519,13 @@ class GenerationEngine:
         # prefix rounds DOWN to a rung and the remainder rides the suffix.
         self._suffix_prefill_fns: Dict[Tuple[int, int, int], Any] = {}
         self._suffix_insert_fns: Dict[Tuple[int, int, int], Any] = {}
+        # speculative-decode families: one fused draft-propose/target-verify
+        # executable per (γ rung, window) — the "(nb, γ) verify rung" of
+        # ISSUE 7 — plus KV-only draft prefill/insert per (nb, bucket)
+        self._spec_fns: Dict[Tuple[int, Optional[int]], Any] = {}
+        self._spec_paged_fns: Dict[Tuple[int, int], Any] = {}
+        self._draft_prefill_fns: Dict[Tuple[int, int], Any] = {}
+        self._draft_insert_fns: Dict[Tuple[int, int], Any] = {}
         self._prefill_bucket_tokens = 0   # bucket rows*cols dispatched to
         self._prefill_real_tokens = 0     # prefill vs real prompt tokens
         self._prefix = None
@@ -738,6 +860,185 @@ class GenerationEngine:
             self._decode_paged_fns[(k_steps, sampled, pw)] = fn
         return fn
 
+    def _draft_prefill_fn(self, nb: int, lb: int):
+        """KV-only draft prefill: runs the draft model over the FULL
+        prompt bucket and returns its small cache — no sampling, no first
+        token (the target's prefill owns both). The draft has no prefix
+        store, so even a prefix-hit group prefills the draft from token
+        zero; the shared ``cache_len`` set by the target insert equals the
+        draft's covered length either way."""
+        fn = self._draft_prefill_fns.get((nb, lb))
+        if fn is None:
+            jax, llama, dcfg = self._jax, self._llama, self.draft_cfg
+
+            def draft_prefill(dparams, tokens, lengths):
+                small = llama.init_cache(dcfg, nb, lb)
+                _, small, _ = llama.prefill(dparams, dcfg, tokens, small,
+                                            lengths=lengths)
+                return small
+
+            fn = jax.jit(draft_prefill)
+            self._draft_prefill_fns[(nb, lb)] = fn
+        return fn
+
+    def _draft_insert_fn(self, nb: int, lb: int):
+        """Scatter a draft prefill's small cache into the big draft cache.
+        Only the draft cache is donated — lengths/last-token state is
+        owned by the target insert."""
+        fn = self._draft_insert_fns.get((nb, lb))
+        if fn is None:
+            jax = self._jax
+
+            def insert(dcache, small, slots):
+                return {name: dcache[name].at[:, slots, :lb].set(
+                    small[name], mode="drop") for name in dcache}
+
+            fn = jax.jit(insert, donate_argnums=(0,))
+            self._draft_insert_fns[(nb, lb)] = fn
+        return fn
+
+    def _spec_fn(self, g: int, window: Optional[int] = None):
+        """Fused draft-propose/target-verify tick (ISSUE 7): the draft
+        scans ``g + 1`` decode steps proposing ``g`` tokens (the extra
+        step writes the last proposal's KV so a full acceptance leaves the
+        draft cache covering every committed position), the target scores
+        all ``g + 1`` positions in ONE batched verify forward, and
+        rejection sampling commits the longest target-consistent prefix
+        plus a bonus token — between 1 and ``g + 1`` tokens per tick.
+
+        Per-row greedy (temperature 0) degenerates to argmax-prefix
+        matching and is token-identical to plain decode; sampled rows
+        preserve the target DISTRIBUTION (not the plain-tick sample path —
+        key consumption differs). Inactive rows freeze exactly like
+        ``_decode_fn``: their garbage KV writes land at frozen positions
+        that are always overwritten before they can be attended.
+
+        Contract: (params, dparams, last_token, cache, dcache, cache_len,
+        active, temps, top_ks, top_ps, keys) → (tokens (g+1, B), accepts
+        (B,), cache, dcache, new_len, new_last, new_keys); row b commits
+        ``accepts[b] + 1`` tokens and cache_len advances by the same."""
+        fn = self._spec_fns.get((g, window))
+        if fn is None:
+            jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
+                                    self.cfg)
+            dcfg = self.draft_cfg
+            from jax import lax
+
+            from gofr_tpu.ops.sampling import (filtered_log_probs_batch,
+                                               speculative_accept)
+
+            def spec_tick(params, dparams, last_token, cache, dcache,
+                          cache_len, active, temps, top_ks, top_ps, keys):
+                split = jax.vmap(
+                    lambda key: jax.random.split(key, g + 2))(keys)
+                draft_keys = jnp.moveaxis(split[:, :g + 1], 0, 1)
+                accept_keys = split[:, g + 1]
+
+                def draft_step(carry, step_keys):
+                    token, dcache, dlen = carry
+                    logits, dcache, new_len = llama.decode_step(
+                        dparams, dcfg, token, dcache, dlen, window=window)
+                    q_logp = filtered_log_probs_batch(logits, temps,
+                                                      top_ks, top_ps)
+                    choice = jax.vmap(jax.random.categorical)(
+                        step_keys, q_logp).astype(jnp.int32)
+                    proposal = jnp.where(temps > 0.0, choice,
+                                         logits.argmax(-1).astype(jnp.int32))
+                    new_len = jnp.where(active, new_len, dlen)
+                    proposal = jnp.where(active, proposal, token)
+                    return (proposal, dcache, new_len), (proposal, q_logp)
+
+                (_, dcache, _), (proposals, q_logps) = lax.scan(
+                    draft_step, (last_token, dcache, cache_len), draft_keys)
+                draft_tokens = proposals[:g].T           # (B, g)
+                q_logp = jnp.moveaxis(q_logps[:g], 0, 1)  # (B, g, V)
+                verify_tokens = jnp.concatenate(
+                    [last_token[:, None], draft_tokens], axis=1)
+                t_logits, cache = llama.verify_step(
+                    params, cfg, verify_tokens, cache, cache_len,
+                    window=window)
+                out, accepts, carry = speculative_accept(
+                    t_logits, q_logp, draft_tokens, temps, top_ks, top_ps,
+                    accept_keys)
+                accepts = jnp.where(active, accepts, 0)
+                chosen = jnp.take_along_axis(
+                    out, accepts[:, None], axis=1)[:, 0].astype(jnp.int32)
+                new_last = jnp.where(active, chosen, last_token)
+                new_len = jnp.where(active, cache_len + accepts + 1,
+                                    cache_len)
+                new_keys = jnp.where(active[:, None], carry, keys)
+                return (out.T, accepts, cache, dcache, new_len, new_last,
+                        new_keys)
+
+            fn = jax.jit(spec_tick, donate_argnums=(3, 4, 5, 10))
+            self._spec_fns[(g, window)] = fn
+        return fn
+
+    def _spec_paged_fn(self, g: int, pw: int):
+        """Paged-target variant of :meth:`_spec_fn`: the draft stays dense
+        (the draft model is small, a dense row per slot keeps it
+        independent of the target's paging), the target verifies through
+        the page table via ``verify_step_paged`` — inactive rows scatter
+        to the sentinel page and drop. ``pw`` must cover fill + g + 1
+        (``_pick_window`` → ``_pick_page_width`` guarantees it; a
+        too-narrow table would silently clamp the per-position gather)."""
+        fn = self._spec_paged_fns.get((g, pw))
+        if fn is None:
+            jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
+                                    self.cfg)
+            dcfg = self.draft_cfg
+            from jax import lax
+
+            from gofr_tpu.ops.sampling import (filtered_log_probs_batch,
+                                               speculative_accept)
+
+            def spec_tick(params, dparams, last_token, pool, dcache, table,
+                          cache_len, active, temps, top_ks, top_ps, keys):
+                split = jax.vmap(
+                    lambda key: jax.random.split(key, g + 2))(keys)
+                draft_keys = jnp.moveaxis(split[:, :g + 1], 0, 1)
+                accept_keys = split[:, g + 1]
+
+                def draft_step(carry, step_keys):
+                    token, dcache, dlen = carry
+                    logits, dcache, new_len = llama.decode_step(
+                        dparams, dcfg, token, dcache, dlen)
+                    q_logp = filtered_log_probs_batch(logits, temps,
+                                                      top_ks, top_ps)
+                    choice = jax.vmap(jax.random.categorical)(
+                        step_keys, q_logp).astype(jnp.int32)
+                    proposal = jnp.where(temps > 0.0, choice,
+                                         logits.argmax(-1).astype(jnp.int32))
+                    new_len = jnp.where(active, new_len, dlen)
+                    proposal = jnp.where(active, proposal, token)
+                    return (proposal, dcache, new_len), (proposal, q_logp)
+
+                (_, dcache, _), (proposals, q_logps) = lax.scan(
+                    draft_step, (last_token, dcache, cache_len), draft_keys)
+                draft_tokens = proposals[:g].T
+                q_logp = jnp.moveaxis(q_logps[:g], 0, 1)
+                verify_tokens = jnp.concatenate(
+                    [last_token[:, None], draft_tokens], axis=1)
+                t_logits, pool = llama.verify_step_paged(
+                    params, cfg, verify_tokens, pool, table, cache_len,
+                    active)
+                out, accepts, carry = speculative_accept(
+                    t_logits, q_logp, draft_tokens, temps, top_ks, top_ps,
+                    accept_keys)
+                accepts = jnp.where(active, accepts, 0)
+                chosen = jnp.take_along_axis(
+                    out, accepts[:, None], axis=1)[:, 0].astype(jnp.int32)
+                new_last = jnp.where(active, chosen, last_token)
+                new_len = jnp.where(active, cache_len + accepts + 1,
+                                    cache_len)
+                new_keys = jnp.where(active[:, None], carry, keys)
+                return (out.T, accepts, pool, dcache, new_len, new_last,
+                        new_keys)
+
+            fn = jax.jit(spec_tick, donate_argnums=(3, 4, 6, 11))
+            self._spec_paged_fns[(g, pw)] = fn
+        return fn
+
     def _table_dev(self, pw: int):
         """Device copy of the first ``pw`` page-table columns, cached per
         gather width and invalidated by host-table version bumps. ``pw``
@@ -903,6 +1204,38 @@ class GenerationEngine:
                                 self.top_ks, self.top_ps, self.sample_keys)
                             (_, self.cache, self.cache_len,
                              self.sample_keys) = out
+            if self.spec:
+                # the speculative ladder: one fused draft+verify executable
+                # per (γ rung, window/width). Inactive-row garbage writes
+                # land at frozen positions that every later insert covers.
+                if self.paged:
+                    widths = list(dict.fromkeys(
+                        self._pick_page_width(w) for w in window_rungs))
+                    for g in self._g_ladder:
+                        for pw in widths:
+                            table = jnp.full((self.max_slots, pw),
+                                             self._pool.sentinel, jnp.int32)
+                            out = self._spec_paged_fn(g, pw)(
+                                self.params, self.draft_params,
+                                self.last_token, self._pool.leaves,
+                                self._draft_cache, table, self.cache_len,
+                                active, self.temps, self.top_ks,
+                                self.top_ps, self.sample_keys)
+                            (_, _, self._pool.leaves, self._draft_cache,
+                             self.cache_len, self.last_token,
+                             self.sample_keys) = out
+                else:
+                    for g in self._g_ladder:
+                        for window in window_rungs:
+                            out = self._spec_fn(g, window)(
+                                self.params, self.draft_params,
+                                self.last_token, self.cache,
+                                self._draft_cache, self.cache_len,
+                                active, self.temps, self.top_ks,
+                                self.top_ps, self.sample_keys)
+                            (_, _, self.cache, self._draft_cache,
+                             self.cache_len, self.last_token,
+                             self.sample_keys) = out
             for lb in self.prompt_buckets:
                 for n in prompt_counts:
                     nb = next(x for x in self._n_ladder if x >= n)
@@ -937,10 +1270,25 @@ class GenerationEngine:
                             self.cache_len, self.last_token, self.temps,
                             self.top_ks, self.top_ps, self.sample_keys,
                             zeros_f, zeros_i, ones_f, keys)
+                    if self.spec:
+                        dsmall = self._draft_prefill_fn(nb, lb)(
+                            self.draft_params, toks, lens)
+                        self._draft_cache = self._draft_insert_fn(nb, lb)(
+                            self._draft_cache, dsmall, slots)
             self._jax.block_until_ready(
                 self._pool.leaves if self.paged else self.cache)
 
-        await loop.run_in_executor(None, compile_all)
+        def compile_locked():
+            # warmup mutates the (possibly shared) pool leaves repeatedly;
+            # hold the pool lock so a co-resident engine's traffic never
+            # interleaves with our donating warmup executions
+            if self.paged:
+                with self._pool.lock:
+                    compile_all()
+            else:
+                compile_all()
+
+        await loop.run_in_executor(None, compile_locked)
 
     # -- public API ---------------------------------------------------------
     async def start(self) -> None:
@@ -982,7 +1330,7 @@ class GenerationEngine:
                  if self.tracer is not None else None)
         link_span = parent if parent is not None else qspan
         record = RequestRecord(
-            model="generate", prompt_len=len(prompt), budget=budget,
+            model=self.model_name, prompt_len=len(prompt), budget=budget,
             trace_id=link_span.trace_id if link_span is not None else None,
             span_id=link_span.span_id if link_span is not None else None)
         self.recorder.start(record)
@@ -998,10 +1346,12 @@ class GenerationEngine:
         ``sampling`` defaults to greedy decoding."""
         prompt, bucket = self._validate(prompt_ids, max_new_tokens)
         future = asyncio.get_running_loop().create_future()
+        flight = self._new_flight(prompt, max_new_tokens)
+        cls = deadline_class(flight.deadline)
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, None,
-                                 time.monotonic(),
-                                 self._new_flight(prompt, max_new_tokens)))
+                                 time.monotonic(), flight, cls), cls)
+        self._set_queue_gauges()
         self._wake.set()
         return await future
 
@@ -1023,10 +1373,12 @@ class GenerationEngine:
         prompt, bucket = self._validate(prompt_ids, max_new_tokens)
         queue: asyncio.Queue = asyncio.Queue()
         future = asyncio.get_running_loop().create_future()
+        flight = self._new_flight(prompt, max_new_tokens)
+        cls = deadline_class(flight.deadline)
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, queue,
-                                 time.monotonic(),
-                                 self._new_flight(prompt, max_new_tokens)))
+                                 time.monotonic(), flight, cls), cls)
+        self._set_queue_gauges()
         self._wake.set()
         return TokenStream(self, queue, future)
 
@@ -1059,7 +1411,8 @@ class GenerationEngine:
         return sum(1 for slot in self._slots if slot.active)
 
     def stats(self) -> Dict[str, Any]:
-        out = {"active_slots": self.active_slots,
+        out = {"model": self.model_name,
+               "active_slots": self.active_slots,
                "free_slots": len(self._free),
                "queue_depth": self._pending.qsize(),
                "decode_steps": self._steps,
@@ -1084,6 +1437,24 @@ class GenerationEngine:
             pool["page_stalls"] = self._page_stalls
             pool["deferred_requests"] = len(self._overflow)
             out["kv_pool"] = pool
+        if self.spec:
+            rate = (self._spec_accepted / self._spec_proposed
+                    if self._spec_proposed else 0.0)
+            out["speculative"] = {
+                "gamma": self.spec_gamma,
+                "gamma_cap": self._gamma_cap,
+                "gamma_ladder": list(self._g_ladder),
+                "spec_ticks": self._spec_ticks,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "acceptance_rate": round(rate, 6),
+            }
+        out["classes"] = {
+            "weights": self._pending.weights(),
+            "depths": self._pending.depths(),
+            "served": self._pending.served(),
+            "shed": dict(self._shed_by_class),
+        }
         return out
 
     def statusz(self, recent: int = 32) -> Dict[str, Any]:
@@ -1095,9 +1466,12 @@ class GenerationEngine:
             slots.append({
                 "slot": slot_idx,
                 "state": "active" if slot.active else "free",
+                "cls": slot.cls if slot.active else None,
                 "fill": slot.fill if slot.active else 0,
                 "remaining": slot.remaining if slot.active else 0,
                 "inflight_tokens": slot.inflight,
+                "spec_accepted": slot.spec_accepted if slot.active else 0,
+                "spec_proposed": slot.spec_proposed if slot.active else 0,
                 "streaming": slot.queue is not None,
                 "pages_held": (len(slot.pages) + len(slot.nodes)
                                if slot.active else 0),
@@ -1191,6 +1565,18 @@ class GenerationEngine:
                                          for w in self._window_ladder}),
                 "pool": self._pool.stats(),
             }
+        if self.spec:
+            # the speculative executable family is the only NEW compile
+            # surface this subsystem adds: (γ rung × window/width), plus
+            # draft prefill/insert riding the existing (nb, bucket) grid
+            out["speculative"] = {
+                "gamma_ladder": list(self._g_ladder),
+                "gamma_cap": self._gamma_cap,
+                "compiled_spec_fns": (len(self._spec_paged_fns)
+                                      if self.paged
+                                      else len(self._spec_fns)),
+                "compiled_draft_prefill_fns": len(self._draft_prefill_fns),
+            }
         return out
 
     def health_check(self) -> Dict[str, Any]:
@@ -1259,8 +1645,15 @@ class GenerationEngine:
             # rebuild the pool leaves and drop every page mapping: slots
             # were already failed, so the table goes back to all-sentinel
             # (the shared prefix index resets below without re-touching
-            # the pool it no longer owns)
-            self._pool.reset()
+            # the pool it no longer owns). The guard keeps the reset
+            # fan-out from re-entering THIS engine's _on_pool_reset —
+            # co-resident engines still get notified.
+            self._in_pool_reset = True
+            try:
+                with self._pool.lock:
+                    self._pool.reset()
+            finally:
+                self._in_pool_reset = False
             self._table = np.full(
                 (self.max_slots, self.pages_per_slot),
                 self._pool.sentinel, np.int32)
@@ -1286,6 +1679,12 @@ class GenerationEngine:
         self.top_ks = jnp.zeros((self.max_slots,), jnp.int32)
         self.top_ps = jnp.ones((self.max_slots,), jnp.float32)
         self.sample_keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
+        if self.spec:
+            # the draft cache's donated handles are as poisoned as the
+            # target's — same failure, same rebuild
+            self._draft_cache = self._jax.device_put(
+                llama.init_cache(self.draft_cfg, self.max_slots,
+                                 self.max_len))
         self._mask_key = None
         # the prefix store's pages may be poisoned too (a failed publish
         # consumed nothing, but the index must not advertise pages whose
@@ -1330,11 +1729,10 @@ class GenerationEngine:
                 and self._ticks_inflight < self.max_inflight_ticks):
             tick = await self._dispatch_tick(loop)
             if tick is not None:
-                tokens_dev, snapshot, step_span = tick
+                kind, fetch, payload, step_span = tick
                 self._ticks_inflight += 1
-                q.append(_Fetch(loop.run_in_executor(None, np.asarray,
-                                                     tokens_dev),
-                                "tick", snapshot, span=step_span))
+                q.append(_Fetch(loop.run_in_executor(None, fetch),
+                                kind, payload, span=step_span))
                 dispatched = True
 
         if not q:
@@ -1358,6 +1756,28 @@ class GenerationEngine:
         if entry.kind == "prefill":
             for slot_idx, gen, row in entry.payload:
                 self._push_tokens(slot_idx, gen, [int(host[row])])
+        elif entry.kind == "spec":
+            self._ticks_inflight -= 1
+            toks, accepts = host
+            snapshot, g = entry.payload
+            proposed = accepted = 0
+            for slot_idx, gen in snapshot:
+                a = int(accepts[slot_idx])
+                slot = self._slots[slot_idx]
+                if slot.gen == gen:
+                    # dispatch charged the g+1 worst case; refund the
+                    # rejected tail so inflight/fill track the device
+                    # advance of a+1 exactly
+                    refund = g - a
+                    slot.inflight -= refund
+                    slot.fill -= refund
+                    slot.spec_proposed += g
+                    slot.spec_accepted += a
+                    proposed += g
+                    accepted += a
+                self._push_tokens(slot_idx, gen,
+                                  [int(t) for t in toks[:a + 1, slot_idx]])
+            self._note_spec(proposed, accepted)
         else:
             self._ticks_inflight -= 1
             for slot_idx, gen in entry.payload:
@@ -1365,6 +1785,39 @@ class GenerationEngine:
                                   [int(t) for t in host[:, slot_idx]])
         if entry.span is not None:   # step span covers dispatch → publish
             entry.span.finish()
+
+    def _note_spec(self, proposed: int, accepted: int) -> None:
+        """Acceptance accounting plus the adaptive-γ controller: every
+        ``_SPEC_WINDOW_TICKS`` speculative ticks the windowed acceptance
+        rate halves the γ cap (draft diverging — wasted verify slots) or
+        doubles it back toward the configured maximum (draft agreeing —
+        leave tokens on the table no longer)."""
+        if proposed <= 0:
+            return
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._spec_window_proposed += proposed
+        self._spec_window_accepted += accepted
+        self._spec_ticks += 1
+        if self.metrics is not None:
+            self.metrics.delta_updown_counter(
+                "app_tpu_spec_proposed_total", float(proposed),
+                model=self.model_name)
+            self.metrics.delta_updown_counter(
+                "app_tpu_spec_accepted_total", float(accepted),
+                model=self.model_name)
+        if self._spec_ticks % _SPEC_WINDOW_TICKS:
+            return
+        rate = self._spec_window_accepted / self._spec_window_proposed
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_tpu_spec_acceptance_rate", rate,
+                                   model=self.model_name)
+        if rate < _SPEC_SHRINK_BELOW:
+            self._gamma_cap = max(1, self._gamma_cap // 2)
+        elif rate > _SPEC_GROW_ABOVE:
+            self._gamma_cap = min(self.spec_gamma, self._gamma_cap * 2)
+        self._spec_window_proposed = 0
+        self._spec_window_accepted = 0
 
     def _prefix_plan(self, prompt: List[int], bucket: int):
         """Plan prefix reuse for one request: look up the longest cached
@@ -1426,7 +1879,7 @@ class GenerationEngine:
         committed = 0      # pages promised to requests admitted this pass
         for ri, request in enumerate(requests):
             prompt, bucket, budget, eos_id, sampling, future, queue, \
-                submitted_at, flight = request
+                submitted_at, flight, cls = request
             if queue is not None and queue in self._cancelled_queues:
                 # stream consumer vanished before admission: drop it
                 self._cancelled_queues.discard(queue)
@@ -1451,7 +1904,7 @@ class GenerationEngine:
                     flight.qspan.finish()
                 self.recorder.finish(flight.record, "expired")
                 if self.slo is not None:
-                    self.slo.record_outcome("expired")
+                    self.slo.record_outcome("expired", cls=cls)
                 if self.logger is not None:
                     self.logger.warn(
                         "engine: shed expired request before prefill "
@@ -1488,8 +1941,10 @@ class GenerationEngine:
                         < need_max + self._kv_reserve):
                     # head-of-line FIFO: defer this and everything popped
                     # after it (admitting a shorter later request first
-                    # would starve long prompts under pressure)
+                    # would starve long prompts under pressure); past the
+                    # deque cap the deepest class sheds its own newest
                     self._overflow.extend(requests[ri:])
+                    self._shed_overflow()
                     break
                 committed += need_max
             p_rung, sb, page_ids, nodes = (
@@ -1502,7 +1957,7 @@ class GenerationEngine:
                 leases.extend(nodes)
             by_group.setdefault((p_rung, sb), []).append(
                 (prompt, budget, eos_id, sampling, future, queue,
-                 submitted_at, flight, page_ids, nodes))
+                 submitted_at, flight, page_ids, nodes, cls))
         if self._pending.empty() and not self._overflow:
             # no queued request can match a leftover entry any more —
             # bound the set (cancel-after-completion would otherwise leak)
@@ -1530,10 +1985,21 @@ class GenerationEngine:
             npg = bucket // self.kv_page if self.paged else 0
             flat_ids = (np.full((nb * npg,), self._pool.sentinel, np.int32)
                         if self.paged else None)
+            db = 0
+            draft_padded = draft_lengths = None
+            if self.spec:
+                # the draft always prefills the FULL prompt (it has no
+                # prefix store), so its bucket covers the longest prompt in
+                # the group — the original bucket of each request is ≥ its
+                # prompt length, so a covering rung always exists
+                db = next(b for b in self.prompt_buckets
+                          if b >= max(len(entry[0]) for entry in group))
+                draft_padded = np.zeros((nb, db), np.int32)
+                draft_lengths = np.ones((nb,), np.int32)
             claimed: List[Tuple[int, int, int]] = []          # (slot,gen,row)
             for row, (prompt, budget, eos_id, sampling, future, queue,
                       submitted_at, flight, page_ids,
-                      nodes) in enumerate(group):
+                      nodes, cls) in enumerate(group):
                 slot_idx = self._free.pop()
                 slot = self._slots[slot_idx]
                 slot.future = future
@@ -1547,6 +2013,9 @@ class GenerationEngine:
                 slot.inflight = 1          # the prefill's first token
                 slot.queue = queue
                 slot.temperature = sampling.temperature
+                slot.cls = cls
+                slot.spec_proposed = 0
+                slot.spec_accepted = 0
                 slot.fill = len(prompt)    # device cache_len after insert
                 # queue.wait ends here; the prefill phase span opens, both
                 # in the request's own trace
@@ -1570,6 +2039,9 @@ class GenerationEngine:
                 padded[row, :len(suffix)] = suffix
                 lengths[row] = len(suffix)
                 self._prefill_real_tokens += len(suffix)
+                if self.spec:
+                    draft_padded[row, :len(prompt)] = prompt
+                    draft_lengths[row] = len(prompt)
                 if p_rung:
                     page_mat[row] = page_ids
                 if self.paged:
@@ -1642,34 +2114,40 @@ class GenerationEngine:
                              top_ks=top_ks, top_ps=top_ps, seeds=seeds,
                              page_mat=page_mat, flat_ids=flat_ids,
                              plen=plen):
-                    if p == 0:
-                        first, small, keys = self._prefill_fn(nb, bucket)(
-                            self.params, jnp.asarray(padded),
-                            jnp.asarray(lengths),
-                            jnp.asarray(temps), jnp.asarray(top_ks),
-                            jnp.asarray(top_ps), jnp.asarray(seeds))
-                    else:
-                        # suffix prefill reads the SAME pool leaves the
-                        # insert below donates — PjRt usage events order
-                        # the read before the aliased write
-                        first, small, keys = self._suffix_prefill_fn(
-                            nb, p, bucket)(
-                            self.params, self._pool.leaves,
-                            jnp.asarray(page_mat), jnp.asarray(padded),
-                            jnp.asarray(lengths), jnp.asarray(temps),
-                            jnp.asarray(top_ks), jnp.asarray(top_ps),
-                            jnp.asarray(seeds))
-                    (leaves, self.cache_len, self.last_token, self.temps,
-                     self.top_ks, self.top_ps, self.sample_keys) = \
-                        self._insert_paged_fn(nb, bucket, plen)(
-                            self._pool.leaves, small,
-                            jnp.asarray(flat_ids), jnp.asarray(slots),
-                            jnp.asarray(lengths), first,
-                            self.cache_len, self.last_token, self.temps,
-                            self.top_ks, self.top_ps, self.sample_keys,
-                            jnp.asarray(temps), jnp.asarray(top_ks),
-                            jnp.asarray(top_ps), keys)
-                    self._pool.leaves = leaves
+                    # pool lock: a co-resident engine's donating dispatch
+                    # must not interleave between our read of the leaves
+                    # handle and the write-back below (tenancy safety)
+                    with self._pool.lock:
+                        if p == 0:
+                            first, small, keys = self._prefill_fn(
+                                nb, bucket)(
+                                self.params, jnp.asarray(padded),
+                                jnp.asarray(lengths),
+                                jnp.asarray(temps), jnp.asarray(top_ks),
+                                jnp.asarray(top_ps), jnp.asarray(seeds))
+                        else:
+                            # suffix prefill reads the SAME pool leaves the
+                            # insert below donates — PjRt usage events order
+                            # the read before the aliased write
+                            first, small, keys = self._suffix_prefill_fn(
+                                nb, p, bucket)(
+                                self.params, self._pool.leaves,
+                                jnp.asarray(page_mat), jnp.asarray(padded),
+                                jnp.asarray(lengths), jnp.asarray(temps),
+                                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                                jnp.asarray(seeds))
+                        (leaves, self.cache_len, self.last_token,
+                         self.temps, self.top_ks, self.top_ps,
+                         self.sample_keys) = \
+                            self._insert_paged_fn(nb, bucket, plen)(
+                                self._pool.leaves, small,
+                                jnp.asarray(flat_ids), jnp.asarray(slots),
+                                jnp.asarray(lengths), first,
+                                self.cache_len, self.last_token, self.temps,
+                                self.top_ks, self.top_ps, self.sample_keys,
+                                jnp.asarray(temps), jnp.asarray(top_ks),
+                                jnp.asarray(top_ps), keys)
+                        self._pool.leaves = leaves
                     self._pool.note_writes(
                         int((flat_ids != self._pool.sentinel).sum()))
                     return first
@@ -1735,27 +2213,52 @@ class GenerationEngine:
                 warm = ((nb, p_rung, bucket) in self._suffix_prefill_fns
                         and (nb, p_rung, bucket) in self._suffix_insert_fns)
 
-            staged.append((nb, bucket, p_rung, warm, dispatch, claimed))
+            draft_dispatch = None
+            if self.spec:
+                def draft_dispatch(nb=nb, db=db, draft_padded=draft_padded,
+                                   draft_lengths=draft_lengths, slots=slots):
+                    small = self._draft_prefill_fn(nb, db)(
+                        self.draft_params, jnp.asarray(draft_padded),
+                        jnp.asarray(draft_lengths))
+                    self._draft_cache = self._draft_insert_fn(nb, db)(
+                        self._draft_cache, small, jnp.asarray(slots))
+
+                warm = (warm and (nb, db) in self._draft_prefill_fns
+                        and (nb, db) in self._draft_insert_fns)
+
+            staged.append((nb, bucket, p_rung, warm, dispatch,
+                           draft_dispatch, claimed))
 
         # Phase 2: dispatch per group (first-time compiles run off-loop;
         # warm dispatch is ~free). Leases release after every dispatch:
         # pinned pages must survive until the suffix gathers that read
         # them are ordered behind any publish that could recycle a page.
         try:
-            for nb, bucket, p_rung, warm, dispatch, claimed in staged:
+            for (nb, bucket, p_rung, warm, dispatch, draft_dispatch,
+                 claimed) in staged:
                 step_span = self._step_span("tpu.engine.prefill", claimed,
                                             bucket=bucket, padded_batch=nb,
                                             prefix_pages=p_rung)
                 if warm:
                     first_dev = dispatch()
+                    if draft_dispatch is not None:
+                        draft_dispatch()
                 else:
-                    first_dev = await loop.run_in_executor(None, dispatch)
+                    def cold(dispatch=dispatch,
+                             draft_dispatch=draft_dispatch):
+                        first = dispatch()
+                        if draft_dispatch is not None:
+                            draft_dispatch()
+                        return first
+
+                    first_dev = await loop.run_in_executor(None, cold)
                 self._prefills += 1
                 self._prefill_bucket_tokens += nb * bucket
                 fetches.append((first_dev, claimed, step_span))
         finally:
             if self._prefix is not None and leases:
                 self._prefix.release(leases)
+        self._set_queue_gauges()
         return fetches
 
     def _step_span(self, name: str, participants,
@@ -1803,6 +2306,16 @@ class GenerationEngine:
             for rung in self._k_ladder:
                 if rung <= min_wanted:
                     k = rung
+            if self.spec and min_wanted >= 2:
+                # speculative rung g commits UP TO g+1 tokens per slot, so
+                # it needs g+1 ≤ min_wanted — the same never-overshoot
+                # invariant as fused-K (device advance is accepts+1 ≤ g+1)
+                g = 0
+                for rung in self._g_ladder:
+                    if rung + 1 <= min_wanted and rung <= self._gamma_cap:
+                        g = rung
+                if g > 0:
+                    return await self._dispatch_spec(loop, eligible, g)
         if self.paged:
             covered = self._cover_pages(eligible, k)
             if not covered:
@@ -1841,20 +2354,24 @@ class GenerationEngine:
 
         def dispatch():
             if self.paged:
-                table = self._table_dev(pw)
-                if sampled:
-                    (tokens_dev, leaves, self.cache_len,
-                     self.sample_keys) = self._decode_paged_fn(
-                        k, sampled=True, pw=pw)(
-                        self.params, self.last_token, self._pool.leaves,
-                        table, self.cache_len, self._mask_dev, self.temps,
-                        self.top_ks, self.top_ps, self.sample_keys)
-                else:
-                    (tokens_dev, leaves,
-                     self.cache_len) = self._decode_paged_fn(k, pw=pw)(
-                        self.params, self.last_token, self._pool.leaves,
-                        table, self.cache_len, self._mask_dev)
-                self._pool.leaves = leaves
+                # pool lock: see the admission dispatch — co-resident
+                # engines' donations must not interleave with ours
+                with self._pool.lock:
+                    table = self._table_dev(pw)
+                    if sampled:
+                        (tokens_dev, leaves, self.cache_len,
+                         self.sample_keys) = self._decode_paged_fn(
+                            k, sampled=True, pw=pw)(
+                            self.params, self.last_token, self._pool.leaves,
+                            table, self.cache_len, self._mask_dev,
+                            self.temps, self.top_ks, self.top_ps,
+                            self.sample_keys)
+                    else:
+                        (tokens_dev, leaves,
+                         self.cache_len) = self._decode_paged_fn(k, pw=pw)(
+                            self.params, self.last_token, self._pool.leaves,
+                            table, self.cache_len, self._mask_dev)
+                    self._pool.leaves = leaves
             elif sampled:
                 (tokens_dev, self.cache, self.cache_len,
                  self.sample_keys) = self._decode_fn(
@@ -1888,10 +2405,10 @@ class GenerationEngine:
                 None)
             self.metrics.record_histogram(
                 "app_tpu_batch_size", float(len(snapshot)),
-                exemplar=exemplar, model="generate")
+                exemplar=exemplar, model=self.model_name)
             self.metrics.set_gauge(
                 "app_tpu_attention_window",
-                float(window or self.max_len), model="generate")
+                float(window or self.max_len), model=self.model_name)
             if self.paged:
                 held = sum(len(s.nodes) + len(s.pages)
                            for _, s in eligible)
@@ -1900,8 +2417,93 @@ class GenerationEngine:
                     self.metrics.set_gauge(
                         "app_tpu_kv_ragged_fill_ratio",
                         min(1.0, filled / (held * self.kv_page)),
-                        model="generate")
-        return tokens_dev, snapshot, step_span
+                        model=self.model_name)
+
+        def fetch(dev=tokens_dev):
+            return np.asarray(dev)
+
+        return "tick", fetch, snapshot, step_span
+
+    async def _dispatch_spec(self, loop, eligible, g: int):
+        """Dispatch one speculative tick at rung ``g``: charge every
+        participating slot ``g + 1`` in-flight tokens (the conservative
+        worst case — ``_publish`` refunds the rejected remainder), run the
+        fused draft+verify executable, and hand back a fetch that lands
+        both the (g+1, B) token matrix and the per-slot accept counts."""
+        jnp = self._jnp
+        if self.paged:
+            covered = self._cover_pages(eligible, g + 1)
+            if not covered:
+                if self._ticks_inflight == 0:
+                    self._shed_newest(eligible)
+                return None
+            eligible = covered
+        active = np.zeros((self.max_slots,), bool)
+        snapshot = []
+        fills = []
+        for slot_idx, slot in eligible:
+            active[slot_idx] = True
+            slot.inflight += g + 1
+            fills.append(slot.fill)
+            # conservative fill mirror: assume full acceptance until the
+            # accepts land; the refund keeps window/page covers safe under
+            # pipelining (an overestimate can only widen the cover)
+            slot.fill += g + 1
+            snapshot.append((slot_idx, slot.gen))
+            if slot.record is not None:
+                slot.record.rode_batch(len(eligible))
+        window = self._pick_window(fills, g + 1)
+        key = active.tobytes()
+        if getattr(self, "_mask_key", None) != key:
+            self._mask_dev = jnp.asarray(active)
+            self._mask_key = key
+        pw = self._pick_page_width(window) if self.paged else 0
+
+        def dispatch():
+            if self.paged:
+                # pool lock: see the admission dispatch — co-resident
+                # engines' donations must not interleave with ours
+                with self._pool.lock:
+                    table = self._table_dev(pw)
+                    (toks_dev, accepts_dev, leaves, self._draft_cache,
+                     self.cache_len, self.last_token,
+                     self.sample_keys) = self._spec_paged_fn(g, pw)(
+                        self.params, self.draft_params, self.last_token,
+                        self._pool.leaves, self._draft_cache, table,
+                        self.cache_len, self._mask_dev, self.temps,
+                        self.top_ks, self.top_ps, self.sample_keys)
+                    self._pool.leaves = leaves
+            else:
+                (toks_dev, accepts_dev, self.cache, self._draft_cache,
+                 self.cache_len, self.last_token,
+                 self.sample_keys) = self._spec_fn(g, window)(
+                    self.params, self.draft_params, self.last_token,
+                    self.cache, self._draft_cache, self.cache_len,
+                    self._mask_dev, self.temps, self.top_ks, self.top_ps,
+                    self.sample_keys)
+            return toks_dev, accepts_dev
+
+        step_span = self._step_span("tpu.engine.spec", snapshot,
+                                    gamma=g, window=window or self.max_len,
+                                    step=self._steps)
+        warm = ((g, pw) in self._spec_paged_fns if self.paged
+                else (g, window) in self._spec_fns)
+        if warm:
+            pair = dispatch()
+        else:
+            pair = await loop.run_in_executor(None, dispatch)
+        self._steps += 1
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_tpu_batch_size", float(len(snapshot)),
+                model=self.model_name)
+            self.metrics.set_gauge("app_tpu_spec_gamma", float(g),
+                                   model=self.model_name)
+
+        def fetch(pair=pair):
+            return np.asarray(pair[0]), np.asarray(pair[1])
+
+        return "spec", fetch, (snapshot, g), step_span
 
     def _cover_pages(self, eligible, k: int):
         """Grow each participating slot's page chain to cover its fill + k
@@ -1956,6 +2558,82 @@ class GenerationEngine:
         if slot_idx not in self._free:
             self._free.append(slot_idx)
 
+    def _shed_overflow(self) -> None:
+        """Bound the page-deferred deque: past the cap, the class with the
+        deepest backlog sheds its own NEWEST entry — strictly within class
+        before any cross-class impact, and LIFO within the class (the
+        newest arrival has the least sunk queue time)."""
+        while len(self._overflow) > self._overflow_cap:
+            depths: Dict[str, int] = {}
+            for entry in self._overflow:
+                depths[entry[9]] = depths.get(entry[9], 0) + 1
+            victim_cls = max(depths.items(), key=lambda kv: kv[1])[0]
+            request = None
+            for i in range(len(self._overflow) - 1, -1, -1):
+                if self._overflow[i][9] == victim_cls:
+                    request = self._overflow[i]
+                    del self._overflow[i]
+                    break
+            if request is None:      # unreachable: victim_cls came from
+                return               # the deque itself
+            prompt, bucket, budget, eos_id, sampling, future, queue, \
+                submitted_at, flight, cls = request
+            exc = RuntimeError(
+                f"admission overflow: more than {self._overflow_cap} "
+                f"page-deferred requests; shedding the newest {cls!r} "
+                f"entry (deepest class)")
+            if not future.done():
+                future.set_exception(exc)
+            if queue is not None:
+                queue.put_nowait(exc)
+            if flight.qspan is not None:
+                flight.qspan.set_status("ERROR")
+                flight.qspan.finish()
+            self.recorder.finish(flight.record, "expired")
+            self._shed_by_class[cls] = self._shed_by_class.get(cls, 0) + 1
+            if self.slo is not None:
+                self.slo.record_outcome("expired", cls=cls)
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_tpu_sched_shed_total", model=self.model_name,
+                    cls=cls)
+            if self.logger is not None:
+                self.logger.warn(
+                    "engine %s: shed overflowed %s request "
+                    "(backlog %d > cap %d)", self.model_name, cls,
+                    len(self._overflow) + 1, self._overflow_cap)
+
+    def _set_queue_gauges(self) -> None:
+        """Per-class admission backlog gauge (WFQ pending + page-deferred
+        overflow). A zero row stays published — a vanishing gauge is
+        indistinguishable from a scrape gap."""
+        if self.metrics is None:
+            return
+        depths = self._pending.depths()
+        for entry in self._overflow:
+            depths[entry[9]] = depths.get(entry[9], 0) + 1
+        for cls, depth in depths.items():
+            self.metrics.set_gauge(
+                "app_tpu_admission_queue_depth", float(depth),
+                model=self.model_name, cls=cls)
+
+    def _on_pool_reset(self) -> None:
+        """Shared-pool reset observer (multi-model tenancy): a co-resident
+        engine rebuilt the pool every page table of THIS engine points
+        into. All page ids and device handles dangle — fail outstanding
+        work and re-sentinel the table. Own resets set ``_in_pool_reset``
+        and skip (the reset path already rebuilds everything)."""
+        if self._in_pool_reset:
+            return
+        self._fail_outstanding(RuntimeError(
+            "shared kv page pool was reset by a co-resident engine"))
+        self._table = np.full((self.max_slots, self.pages_per_slot),
+                              self._pool.sentinel, np.int32)
+        self._table_version += 1
+        self._table_cache.clear()
+        if self._prefix is not None:
+            self._prefix.reset()
+
     def _push_tokens(self, slot_idx: int, gen: int,
                      tokens: List[int]) -> None:
         """Append generated tokens to a slot, handling eos/budget; stale
@@ -1980,7 +2658,7 @@ class GenerationEngine:
                     exemplar=({"trace_id": slot.record.trace_id}
                               if slot.record is not None
                               and slot.record.trace_id else None),
-                    model="generate")
+                    model=self.model_name)
             if self.slo is not None:
                 self.slo.record_ttft(ttft)
             # prefill phase ends at the first token; decode begins
@@ -1991,9 +2669,11 @@ class GenerationEngine:
                 slot.phase_span = self.tracer.start_span(
                     "decode", parent=slot.req_span)
                 slot.phase_span.set_attribute("slot", slot_idx)
+        pushed = 0
         for token in tokens:
             slot.tokens.append(token)
             slot.remaining -= 1
+            pushed += 1
             if slot.record is not None:
                 slot.record.tokens += 1
             if self.slo is not None:
@@ -2011,7 +2691,7 @@ class GenerationEngine:
                     # late → violated (work done, value lost)
                     self.slo.record_outcome(
                         self.slo.classify(slot.deadline),
-                        tokens=float(len(slot.tokens)))
+                        tokens=float(len(slot.tokens)), cls=slot.cls)
                 self._finish_slot(slot, "done")
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_result(list(slot.tokens))
@@ -2019,6 +2699,12 @@ class GenerationEngine:
                     slot.queue.put_nowait(_DONE)
                     slot.queue = None
                 break
+        if pushed and self.metrics is not None:
+            # per-class tick share actually delivered — the observable
+            # output of WFQ admission (weights shape THIS distribution)
+            self.metrics.delta_updown_counter(
+                "app_tpu_sched_tokens_total", float(pushed),
+                model=self.model_name, cls=slot.cls)
 
     def _release_slot_kv(self, slot_idx: int, slot: _Slot) -> None:
         """Return a finished slot's KV footprint to the shared pool

@@ -39,7 +39,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-__all__ = ["PagePool"]
+__all__ = ["PagePool", "HBMBudget"]
 
 
 class PagePool:
@@ -51,11 +51,20 @@ class PagePool:
                  num_pages: Optional[int] = None,
                  budget_bytes: Optional[int] = None,
                  mesh=None, metrics=None):
+        import threading
+
         import jax
         import numpy as np
 
         self._jax = jax
         self._np = np
+        # Serializes donating executions against the pool leaves. One
+        # engine's dispatches are already serialized on its loop, but
+        # co-resident engines (multi-model tenancy) each run cold
+        # dispatches in executor threads: engine A's donation deletes the
+        # handle engine B captured unless call + leaves write-back form
+        # one critical section.
+        self.lock = threading.RLock()
         self.cfg = cfg
         self.mesh = mesh
         self.metrics = metrics
@@ -74,6 +83,7 @@ class PagePool:
         self.leaves: Dict[str, Any] = {}
         self._free: List[int] = []
         self._refs = np.zeros((self.num_pages,), np.int32)
+        self._reset_subscribers: List[Callable[[], None]] = []
         self.reset()
 
     @property
@@ -124,11 +134,23 @@ class PagePool:
         """Fresh device buffers, empty ownership. Called at engine
         device-state reset: a failed donating executable may have
         poisoned any in-flight handle. Honors a caller-resized
-        ``num_pages`` (tests shrink pools to force eviction)."""
+        ``num_pages`` (tests shrink pools to force eviction). When the
+        pool is shared by several engines (multi-model tenancy), every
+        subscriber is notified so co-resident owners can drop their now
+        dangling page ids and device handles."""
         self._free = list(range(self.num_pages))
         self._refs = self._np.zeros((self.num_pages,), self._np.int32)
         self._init_leaves()
         self._set_gauges()
+        for callback in list(self._reset_subscribers):
+            callback()
+
+    def subscribe(self, callback: Callable[[], None]) -> None:
+        """Register a reset observer. A co-resident engine uses this to
+        learn that another owner tore the pool down (its own page tables
+        now point at freed pages and must be re-sentineled)."""
+        if callback not in self._reset_subscribers:
+            self._reset_subscribers.append(callback)
 
     # -- ownership ----------------------------------------------------------
     def alloc(self, n: int = 1,
@@ -213,4 +235,58 @@ class PagePool:
             "allocs": self.allocs,
             "writes": self.writes,
             "stalls": self.stalls,
+        }
+
+
+class HBMBudget:
+    """Byte-granular HBM arbiter for multi-model tenancy.
+
+    Engines with the *same* KV geometry share one :class:`PagePool`
+    instance directly (page ids are interchangeable). Heterogeneous
+    co-residents (different head counts, dtypes, page sizes) cannot share
+    pages, so the registry carves the chip's KV budget in bytes instead:
+    each model's carve becomes its own pool's ``budget_bytes``. The
+    arbiter only does conservative bookkeeping — it never talks to the
+    device — but it turns "two models silently OOM-ing each other" into
+    an explicit, observable admission failure at load time.
+    """
+
+    def __init__(self, total_bytes: int):
+        if total_bytes <= 0:
+            raise ValueError("HBMBudget needs a positive byte budget")
+        self.total_bytes = int(total_bytes)
+        self._carves: Dict[str, int] = {}
+
+    @property
+    def carved_bytes(self) -> int:
+        return sum(self._carves.values())
+
+    @property
+    def free_bytes(self) -> int:
+        return self.total_bytes - self.carved_bytes
+
+    def carve(self, name: str, nbytes: int) -> int:
+        """Reserve ``nbytes`` for ``name``; raises when the remaining
+        budget cannot cover it (fail at load, not mid-traffic)."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            raise ValueError(f"carve({name!r}) needs a positive size")
+        if name in self._carves:
+            raise ValueError(f"model {name!r} already holds a carve")
+        if nbytes > self.free_bytes:
+            raise ValueError(
+                f"HBM budget exhausted: {name!r} wants {nbytes} bytes, "
+                f"{self.free_bytes} of {self.total_bytes} remain")
+        self._carves[name] = nbytes
+        return nbytes
+
+    def release(self, name: str) -> None:
+        self._carves.pop(name, None)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "total_bytes": self.total_bytes,
+            "carved_bytes": self.carved_bytes,
+            "free_bytes": self.free_bytes,
+            "carves": dict(self._carves),
         }

@@ -1,0 +1,164 @@
+"""SLO-class weighted-fair admission queues.
+
+Multi-model tenancy (ISSUE 7) turns the engine's single FIFO admission
+queue into a fairness problem: co-resident models and tenants share one
+page pool and one decode tick, so a burst of batch traffic must not
+starve interactive requests that carry tight deadlines — and vice versa,
+an interactive tenant must not monopolize every admission round just by
+arriving often. The classic answer is weighted fair queueing over
+*virtual time*: each class owns a FIFO; a pop takes from the non-empty
+class with the smallest virtual clock, then advances that clock by
+``1 / weight``. A class with weight 4 therefore drains 4 items for every
+1 a weight-1 class drains when both are backlogged, yet an idle class
+loses nothing (its clock is re-anchored to the current minimum on first
+arrival, the standard anti-starvation rule — an empty class must not
+bank credit while idle and then lock out everyone else).
+
+Classes are derived from the request deadline (``slo.py`` contract):
+
+- ``interactive`` — deadline budget at or under ``SLO_CLASS_INTERACTIVE_MS``
+  (default 2000 ms); a human is waiting.
+- ``standard`` — any other finite deadline.
+- ``batch`` — no deadline; throughput traffic.
+
+The same class labels flow through to the overflow deque (requests
+admitted past the free-slot/page budget), per-class shed accounting, and
+the ``app_tpu_admission_queue_depth{model,cls}`` gauge, so one tenant's
+burst is visible — and sheddable — without touching another class.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Iterable, Optional, Tuple
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_STANDARD = "standard"
+CLASS_BATCH = "batch"
+
+SLO_CLASSES = (CLASS_INTERACTIVE, CLASS_STANDARD, CLASS_BATCH)
+
+DEFAULT_CLASS_WEIGHTS: Dict[str, float] = {
+    CLASS_INTERACTIVE: 4.0,
+    CLASS_STANDARD: 2.0,
+    CLASS_BATCH: 1.0,
+}
+
+# Deadline budget at or below this is "a human is waiting" traffic.
+DEFAULT_INTERACTIVE_BUDGET_S = 2.0
+
+
+def deadline_class(deadline: Optional[float], now: Optional[float] = None,
+                   interactive_budget_s: float = DEFAULT_INTERACTIVE_BUDGET_S
+                   ) -> str:
+    """Map an absolute monotonic deadline to an SLO class."""
+    if deadline is None:
+        return CLASS_BATCH
+    now = time.monotonic() if now is None else now
+    if deadline - now <= interactive_budget_s:
+        return CLASS_INTERACTIVE
+    return CLASS_STANDARD
+
+
+def parse_class_weights(spec: Optional[str]) -> Dict[str, float]:
+    """Parse ``"interactive:4,standard:2,batch:1"`` into a weight map.
+
+    Unknown class names are accepted (forward-compatible with per-tenant
+    classes); malformed entries are skipped rather than failing startup —
+    a bad knob must never take the replica down. Missing classes fall
+    back to the defaults so a partial override stays safe.
+    """
+    weights = dict(DEFAULT_CLASS_WEIGHTS)
+    if not spec:
+        return weights
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        name, _, raw = part.partition(":")
+        try:
+            weight = float(raw)
+        except ValueError:
+            continue
+        if weight > 0:
+            weights[name.strip()] = weight
+    return weights
+
+
+class ClassQueues:
+    """Weighted-fair pending queue, API-compatible with the subset of
+    ``asyncio.Queue`` the generation engine uses (``put`` / ``get_nowait``
+    / ``empty`` / ``qsize``). ``put`` never blocks — admission control
+    happens downstream at the free-slot/page-budget gate — but stays a
+    coroutine so existing ``await pending.put(...)`` call sites work
+    unchanged."""
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None):
+        self._weights = dict(weights or DEFAULT_CLASS_WEIGHTS)
+        self._queues: Dict[str, Deque[Any]] = {}
+        self._vt: Dict[str, float] = {}
+        self._served: Dict[str, int] = {}
+
+    def _weight(self, cls: str) -> float:
+        return self._weights.get(cls, 1.0)
+
+    async def put(self, item: Any, cls: str = CLASS_BATCH) -> None:
+        self.put_nowait(item, cls)
+
+    def put_nowait(self, item: Any, cls: str = CLASS_BATCH) -> None:
+        queue = self._queues.get(cls)
+        if queue is None:
+            queue = self._queues[cls] = deque()
+            self._vt.setdefault(cls, 0.0)
+        if not queue:
+            # re-anchor: an idle class resumes at the current minimum so
+            # it neither banks credit nor starts hopelessly behind
+            active = [self._vt[c] for c, q in self._queues.items() if q]
+            floor = min(active) if active else 0.0
+            self._vt[cls] = max(self._vt.get(cls, 0.0), floor)
+        queue.append(item)
+
+    def get_nowait(self) -> Any:
+        """Pop from the backlogged class with the smallest virtual time."""
+        candidates = [(self._vt[c], c) for c, q in self._queues.items() if q]
+        if not candidates:
+            raise IndexError("get_nowait() on empty ClassQueues")
+        _, cls = min(candidates)
+        item = self._queues[cls].popleft()
+        self._vt[cls] += 1.0 / self._weight(cls)
+        self._served[cls] = self._served.get(cls, 0) + 1
+        return item
+
+    def empty(self) -> bool:
+        return not any(self._queues.values())
+
+    def qsize(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def depths(self) -> Dict[str, int]:
+        """Per-class backlog, always including the three standard classes
+        (a zero row is a signal too — gauges should not disappear)."""
+        out = {cls: 0 for cls in SLO_CLASSES}
+        for cls, queue in self._queues.items():
+            out[cls] = len(queue)
+        return out
+
+    def served(self) -> Dict[str, int]:
+        return dict(self._served)
+
+    def weights(self) -> Dict[str, float]:
+        return dict(self._weights)
+
+    def drain(self) -> Iterable[Tuple[str, Any]]:
+        """Remove and yield every queued ``(cls, item)`` — shutdown path."""
+        for cls, queue in self._queues.items():
+            while queue:
+                yield cls, queue.popleft()
+
+
+__all__ = [
+    "CLASS_INTERACTIVE", "CLASS_STANDARD", "CLASS_BATCH", "SLO_CLASSES",
+    "DEFAULT_CLASS_WEIGHTS", "DEFAULT_INTERACTIVE_BUDGET_S",
+    "deadline_class", "parse_class_weights", "ClassQueues",
+]

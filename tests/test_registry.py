@@ -1,0 +1,300 @@
+"""ModelRegistry tests: lifecycle, routing/fallback, multi-model tenancy
+on one shared KV page pool, MoE served through the engine (CPU)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama, moe
+from gofr_tpu.slo import STATE_DEGRADED
+from gofr_tpu.tpu import (GenerationEngine, HBMBudget, ModelRegistry,
+                          ModelUnavailable, PagePool)
+from gofr_tpu.tpu.registry import (STATE_DRAINING, STATE_LOADING,
+                                   STATE_READY, STATE_UNLOADED,
+                                   STATE_WARMING)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(container, cfg, params, name, **kwargs):
+    kwargs.setdefault("max_slots", 2)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8,))
+    return GenerationEngine(cfg, params, model_name=name,
+                            logger=container.logger,
+                            metrics=container.metrics, **kwargs)
+
+
+class _FakeWatchdog:
+    def __init__(self, state="READY"):
+        self.state = state
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def test_lifecycle_states_and_gauge(setup):
+    cfg, params = setup
+    container = new_mock_container()
+    registry = ModelRegistry(logger=container.logger,
+                             metrics=container.metrics)
+    engine = _engine(container, cfg, params, "m")
+    registry.register("m", engine)
+    assert registry._entries["m"].state == STATE_LOADING
+    assert container.metrics.value("app_tpu_model_state", model="m") == 0.0
+
+    async def main():
+        warm = registry.warmup("m", prompt_counts=(1,))
+        task = asyncio.ensure_future(warm)
+        await asyncio.sleep(0)   # warmup sets WARMING before compiling
+        assert registry._entries["m"].state in (STATE_WARMING, STATE_READY)
+        await task
+        assert registry._entries["m"].state == STATE_READY
+        assert container.metrics.value(
+            "app_tpu_model_state", model="m") == 2.0
+        await registry.start("m")
+        out = await registry.route("m").generate([1, 2, 3],
+                                                 max_new_tokens=4)
+        assert len(out) == 4
+        drained = await registry.drain("m", timeout_s=5.0)
+        assert drained
+        assert registry._entries["m"].state == STATE_DRAINING
+        await registry.unload("m")
+        assert registry._entries["m"].state == STATE_UNLOADED
+        assert container.metrics.value(
+            "app_tpu_model_state", model="m") == 4.0
+
+    asyncio.run(main())
+
+
+def test_register_validation(setup):
+    cfg, params = setup
+    container = new_mock_container()
+    registry = ModelRegistry(logger=container.logger)
+    engine = _engine(container, cfg, params, "a")
+    registry.register("a", engine)
+    with pytest.raises(ValueError, match="already registered"):
+        registry.register("a", engine)
+    with pytest.raises(ValueError, match="fall back to itself"):
+        registry.register("b", engine, fallback="b")
+    with pytest.raises(KeyError, match="unknown model"):
+        registry.route("nope")
+    assert registry.default_model == "a"   # first registration wins
+    assert registry.models() == ["a"]      # failed registrations left out
+
+
+# -- routing and fallback ----------------------------------------------------
+
+def test_route_fallback_on_non_ready_and_degraded(setup):
+    cfg, params = setup
+    container = new_mock_container()
+    dog = _FakeWatchdog()
+    registry = ModelRegistry(watchdog=dog, logger=container.logger,
+                             metrics=container.metrics)
+    big = _engine(container, cfg, params, "big")
+    cheap = _engine(container, cfg, params, "cheap")
+    registry.register("big", big, fallback="cheap", default=True)
+    registry.register("cheap", cheap)
+
+    async def main():
+        await registry.start()
+        assert registry.route("big") is big
+        assert registry.route() is big         # default route
+
+        # watchdog DEGRADED: big sheds to its cheap fallback; cheap has
+        # no fallback and keeps serving (brown-out, not outage)
+        dog.state = STATE_DEGRADED
+        assert registry.route("big") is cheap
+        assert registry.route("cheap") is cheap
+        assert container.metrics.value(
+            "app_tpu_model_fallback_total", model="big", to="cheap") == 1.0
+        dog.state = "READY"
+
+        # non-READY entry: draining big also sheds to cheap
+        await registry.drain("big", timeout_s=5.0)
+        assert registry.route("big") is cheap
+        assert registry.stats()["fallbacks_taken"]["big->cheap"] == 2
+
+        # nothing READY anywhere → ModelUnavailable with 503 semantics
+        await registry.unload("cheap")
+        with pytest.raises(ModelUnavailable) as err:
+            registry.route("big")
+        assert err.value.status_code == 503
+        await registry.stop()
+
+    asyncio.run(main())
+
+
+def test_health_aggregation(setup):
+    cfg, params = setup
+    container = new_mock_container()
+    registry = ModelRegistry(logger=container.logger)
+    registry.register("m", _engine(container, cfg, params, "m"))
+    # nothing READY yet → DOWN (the replica cannot serve)
+    assert registry.health_check()["status"] == "DOWN"
+
+    async def main():
+        await registry.start()
+        health = registry.health_check()
+        assert health["status"] == "UP"
+        assert health["details"]["models"]["m"]["state"] == STATE_READY
+        await registry.stop()
+
+    asyncio.run(main())
+
+
+# -- multi-model tenancy on one page pool ------------------------------------
+
+def test_two_models_share_one_page_pool(setup):
+    """Two co-resident engines draw pages from one literal PagePool;
+    per-model occupancy is visible in the registry statusz and the pool
+    occupancy is chip-global."""
+    cfg, params = setup
+    container = new_mock_container()
+    pool = PagePool(cfg, page=8, num_pages=64, metrics=container.metrics)
+    registry = ModelRegistry(page_pool=pool, logger=container.logger,
+                             metrics=container.metrics)
+    kw = dict(paged_kv=True, kv_page=8, page_pool=pool)
+    big = _engine(container, cfg, params, "big", **kw)
+    cheap = _engine(container, cfg, params, "cheap", **kw)
+    registry.register("big", big, fallback="cheap")
+    registry.register("cheap", cheap)
+
+    async def main():
+        await registry.start()
+        outs = await asyncio.gather(
+            registry.route("big").generate([1, 2, 3], max_new_tokens=6),
+            registry.route("cheap").generate([1, 2, 3], max_new_tokens=6))
+        # same params, same pool geometry → identical greedy outputs
+        assert outs[0] == outs[1]
+        stats = registry.stats()
+        assert stats["shared_pool"]["allocs"] >= 2  # both models allocated
+        sz = registry.statusz(recent=8)
+        for name in ("big", "cheap"):
+            assert sz["models"][name]["kv_cache"]["pool_pages"] == 64
+        await registry.stop()
+
+    asyncio.run(main())
+
+
+def test_shared_pool_reset_fails_coresident_requests(setup):
+    """One engine's device-state reset tears down the shared pool; the
+    co-resident engine is notified, fails outstanding work, and serves
+    fresh requests afterwards."""
+    cfg, params = setup
+    container = new_mock_container()
+    pool = PagePool(cfg, page=8, num_pages=64)
+    kw = dict(paged_kv=True, kv_page=8, page_pool=pool)
+    a = _engine(container, cfg, params, "a", **kw)
+    b = _engine(container, cfg, params, "b", **kw)
+
+    async def main():
+        await a.start()
+        await b.start()
+        try:
+            out = await b.generate([1, 2], max_new_tokens=4)
+            # engine a resets the shared pool out from under b
+            a._reset_device_state()
+            # b's tables were re-sentineled by the subscription; new work
+            # must still complete (fresh pages from the reset pool)
+            out2 = await b.generate([1, 2], max_new_tokens=4)
+            assert out2 == out
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(main())
+
+
+def test_page_pool_geometry_validation(setup):
+    """A shared pool whose page geometry disagrees with the engine's
+    config must fail at construction, not corrupt KV mid-traffic."""
+    cfg, params = setup
+    container = new_mock_container()
+    pool = PagePool(cfg, page=8, num_pages=32)
+    with pytest.raises(ValueError):
+        _engine(container, cfg, params, "bad",
+                paged_kv=True, kv_page=16, page_pool=pool)
+
+
+def test_hbm_budget_carves():
+    budget = HBMBudget(1000)
+    assert budget.carve("big", 600) == 600
+    with pytest.raises(ValueError, match="exhausted"):
+        budget.carve("huge", 600)
+    with pytest.raises(ValueError, match="already holds"):
+        budget.carve("big", 100)
+    budget.release("big")
+    assert budget.free_bytes == 1000
+    with pytest.raises(ValueError):
+        budget.carve("zero", 0)
+    with pytest.raises(ValueError):
+        HBMBudget(0)
+
+
+# -- MoE through the serving engine ------------------------------------------
+
+def test_moe_served_through_engine_greedy_identity():
+    """models/moe.py serves through GenerationEngine (dense path) and the
+    engine output equals stepping the MoE serving functions by hand.
+    float32: MoE routing decisions amplify bf16 near-ties."""
+    cfg = moe.config("tiny", base=llama.config("tiny", dtype=jnp.float32))
+    params = moe.init(cfg, jax.random.PRNGKey(0))
+    prompt, n_new = [3, 17, 42, 9], 8
+
+    cache = moe.init_cache(cfg, 1, 64)
+    logits, cache, clen = moe.prefill(
+        params, cfg, jnp.asarray([prompt], jnp.int32), cache)
+    ref = []
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    ref.append(int(tok[0]))
+    for _ in range(n_new - 1):
+        logits, cache, clen = moe.decode_step(params, cfg, tok, cache, clen)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        ref.append(int(tok[0]))
+
+    async def main():
+        container = new_mock_container()
+        engine = GenerationEngine(cfg, params, max_slots=2, max_len=64,
+                                  prompt_buckets=(8,), model_module=moe,
+                                  model_name="moe",
+                                  logger=container.logger,
+                                  metrics=container.metrics)
+        await engine.start()
+        try:
+            out = await engine.generate(prompt, max_new_tokens=n_new)
+        finally:
+            await engine.stop()
+        assert out == ref
+        assert engine.stats()["model"] == "moe"
+
+    asyncio.run(main())
+
+
+def test_moe_module_validation():
+    """Custom model modules serve dense-only: paged KV requires a paged
+    decode step, prefix cache and speculative decode require llama."""
+    cfg = moe.config("tiny")
+    params = moe.init(cfg, jax.random.PRNGKey(0))
+    container = new_mock_container()
+    common = dict(max_slots=2, max_len=64, prompt_buckets=(8,),
+                  model_module=moe, logger=container.logger)
+    with pytest.raises(ValueError, match="decode_step_paged"):
+        GenerationEngine(cfg, params, paged_kv=True, **common)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        GenerationEngine(cfg, params, prefix_cache=True, **common)
+    with pytest.raises(ValueError, match="speculative"):
+        GenerationEngine(cfg, params, draft_cfg=cfg, draft_params=params,
+                         **common)
+    with pytest.raises(ValueError, match="bf16-only"):
+        bad = moe.config("tiny",
+                         base=llama.config("tiny", kv_int8=True))
+        moe.prefill(params, bad, jnp.zeros((1, 4), jnp.int32),
+                    moe.init_cache(cfg, 1, 16))

@@ -1,0 +1,232 @@
+"""SLO-class weighted-fair scheduling tests: deadline classification,
+ClassQueues WFQ ordering, overflow shedding, queue-depth gauges."""
+
+import asyncio
+
+import jax
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.tpu.generate import GenerationEngine, Sampling
+from gofr_tpu.tpu.sched import (CLASS_BATCH, CLASS_INTERACTIVE,
+                                CLASS_STANDARD, DEFAULT_CLASS_WEIGHTS,
+                                ClassQueues, deadline_class,
+                                parse_class_weights)
+
+
+# -- deadline classification -------------------------------------------------
+
+def test_deadline_class_boundaries():
+    now = 100.0
+    assert deadline_class(None, now=now) == CLASS_BATCH
+    assert deadline_class(now + 0.5, now=now) == CLASS_INTERACTIVE
+    assert deadline_class(now + 2.0, now=now) == CLASS_INTERACTIVE
+    assert deadline_class(now + 2.001, now=now) == CLASS_STANDARD
+    assert deadline_class(now - 1.0, now=now) == CLASS_INTERACTIVE
+    # a custom interactive budget moves the boundary
+    assert deadline_class(now + 5.0, now=now,
+                          interactive_budget_s=10.0) == CLASS_INTERACTIVE
+
+
+def test_parse_class_weights():
+    assert parse_class_weights(None) == DEFAULT_CLASS_WEIGHTS
+    assert parse_class_weights("") == DEFAULT_CLASS_WEIGHTS
+    weights = parse_class_weights("interactive:8,batch:0.5")
+    assert weights["interactive"] == 8.0
+    assert weights["batch"] == 0.5
+    assert weights["standard"] == DEFAULT_CLASS_WEIGHTS["standard"]
+    # malformed entries are skipped, never fatal; non-positive rejected
+    weights = parse_class_weights("junk,interactive:abc,standard:-3,a:b:c")
+    assert weights == DEFAULT_CLASS_WEIGHTS
+    # unknown classes accepted (forward-compatible per-tenant classes)
+    assert parse_class_weights("tenant-x:7")["tenant-x"] == 7.0
+
+
+# -- weighted-fair queues ----------------------------------------------------
+
+def test_wfq_drain_ratio_follows_weights():
+    """With all classes backlogged, one full virtual-time round serves
+    classes in proportion to their 4:2:1 weights."""
+    queues = ClassQueues()
+    for i in range(8):
+        queues.put_nowait(("i", i), CLASS_INTERACTIVE)
+        queues.put_nowait(("s", i), CLASS_STANDARD)
+        queues.put_nowait(("b", i), CLASS_BATCH)
+    first_round = [queues.get_nowait()[0] for _ in range(7)]
+    assert sorted(first_round) == ["b", "i", "i", "i", "i", "s", "s"]
+    # ...and the ratio holds until interactive's backlog of 8 drains
+    more = [queues.get_nowait()[0] for _ in range(14)]
+    assert more.count("i") == 4  # 8 total: weighted share until empty
+    served = queues.served()
+    assert served[CLASS_INTERACTIVE] == 8
+
+
+def test_wfq_fifo_within_class():
+    queues = ClassQueues()
+    for i in range(4):
+        queues.put_nowait(i, CLASS_STANDARD)
+    assert [queues.get_nowait() for _ in range(4)] == [0, 1, 2, 3]
+
+
+def test_wfq_idle_class_reanchors():
+    """A class that went idle resumes at the current minimum virtual
+    time: it neither banks credit while idle nor starts hopelessly
+    behind the classes that kept running."""
+    queues = ClassQueues()
+    # batch runs alone for a while, building up virtual time
+    for i in range(6):
+        queues.put_nowait(("b", i), CLASS_BATCH)
+    for _ in range(6):
+        queues.get_nowait()
+    # interactive arrives fresh: it must NOT get 6 weights' worth of
+    # catch-up credit — but must also not be starved. With batch
+    # backlogged again, interactive (re-anchored to batch's vt) wins
+    # the next 4-of-5 pops by weight.
+    for i in range(6):
+        queues.put_nowait(("b2", i), CLASS_BATCH)
+    for i in range(6):
+        queues.put_nowait(("i", i), CLASS_INTERACTIVE)
+    window = [queues.get_nowait()[0] for _ in range(5)]
+    assert window.count("i") == 4
+    assert window.count("b2") == 1
+
+
+def test_wfq_empty_and_depths():
+    queues = ClassQueues()
+    assert queues.empty()
+    with pytest.raises(IndexError):
+        queues.get_nowait()
+    queues.put_nowait("x", CLASS_BATCH)
+    assert queues.qsize() == 1
+    depths = queues.depths()
+    assert depths == {CLASS_INTERACTIVE: 0, CLASS_STANDARD: 0,
+                      CLASS_BATCH: 1}
+    assert list(queues.drain()) == [(CLASS_BATCH, "x")]
+    assert queues.empty()
+
+
+# -- engine integration: shed accounting and depth gauges --------------------
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _stub_request(engine, cls, loop):
+    """A page-deferred admission entry as _admit_pending stages it."""
+    flight = engine._new_flight([1, 2, 3], budget=4)
+    future = loop.create_future()
+    return ([1, 2, 3], 8, 4, None, Sampling(), future, None, 0.0,
+            flight, cls)
+
+
+def test_shed_overflow_strictly_within_class(setup):
+    """Past the overflow cap, the deepest class sheds its own newest
+    entry — other classes' entries survive untouched."""
+    cfg, params = setup
+    container = new_mock_container()
+    engine = GenerationEngine(cfg, params, max_slots=4, max_len=64,
+                              prompt_buckets=(8,), model_name="m",
+                              logger=container.logger,
+                              metrics=container.metrics)
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        engine._overflow_cap = 4
+        futures = []
+        # 4 batch entries (the deep class), then 1 interactive
+        for _ in range(4):
+            req = _stub_request(engine, CLASS_BATCH, loop)
+            engine._overflow.append(req)
+            futures.append((CLASS_BATCH, req[5]))
+        interactive = _stub_request(engine, CLASS_INTERACTIVE, loop)
+        engine._overflow.append(interactive)
+        futures.append((CLASS_INTERACTIVE, interactive[5]))
+
+        engine._shed_overflow()
+        assert len(engine._overflow) == 4
+        # the NEWEST batch entry was shed; interactive survived
+        shed = [f for cls, f in futures if f.done()]
+        assert len(shed) == 1
+        assert shed[0] is futures[3][1]
+        assert not interactive[5].done()
+        with pytest.raises(RuntimeError, match="admission overflow"):
+            shed[0].result()
+        assert engine._shed_by_class == {CLASS_BATCH: 1}
+        assert container.metrics.value(
+            "app_tpu_sched_shed_total", model="m", cls=CLASS_BATCH) == 1.0
+        # drain the remaining futures so the loop shuts down clean
+        engine._fail_outstanding(RuntimeError("test teardown"))
+
+    asyncio.run(main())
+
+
+def test_queue_depth_gauges_per_class(setup):
+    cfg, params = setup
+    container = new_mock_container()
+    engine = GenerationEngine(cfg, params, max_slots=4, max_len=64,
+                              prompt_buckets=(8,), model_name="m",
+                              logger=container.logger,
+                              metrics=container.metrics)
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        engine._overflow.append(_stub_request(engine, CLASS_BATCH, loop))
+        engine._pending.put_nowait(("x",), CLASS_INTERACTIVE)
+        engine._set_queue_gauges()
+        value = container.metrics.value
+        assert value("app_tpu_admission_queue_depth",
+                     model="m", cls=CLASS_INTERACTIVE) == 1.0
+        assert value("app_tpu_admission_queue_depth",
+                     model="m", cls=CLASS_BATCH) == 1.0
+        assert value("app_tpu_admission_queue_depth",
+                     model="m", cls=CLASS_STANDARD) == 0.0
+        engine._fail_outstanding(RuntimeError("test teardown"))
+
+    asyncio.run(main())
+
+
+def test_engine_serves_mixed_classes_to_completion(setup):
+    """Requests across classes (deadline-derived) all finish; per-class
+    served counts and token accounting land in stats()."""
+    from gofr_tpu.slo import set_request_deadline
+    cfg, params = setup
+    container = new_mock_container()
+    engine = GenerationEngine(cfg, params, max_slots=2, max_len=64,
+                              prompt_buckets=(8,), model_name="m",
+                              logger=container.logger,
+                              metrics=container.metrics)
+
+    async def main():
+        await engine.start()
+        try:
+            # first request compiles the executables — keep it deadline-
+            # free so cold-compile time cannot expire it
+            await engine.generate([9, 9], max_new_tokens=4)
+
+            async def interactive():
+                set_request_deadline(1500.0)
+                try:
+                    return await engine.generate([1, 2], max_new_tokens=4)
+                finally:
+                    set_request_deadline(None)
+
+            outs = await asyncio.gather(
+                interactive(),
+                engine.generate([1, 2], max_new_tokens=4),
+                engine.generate([3, 4], max_new_tokens=4))
+            assert all(len(o) == 4 for o in outs)
+        finally:
+            await engine.stop()
+        classes = engine.stats()["classes"]
+        assert classes["served"].get(CLASS_INTERACTIVE, 0) >= 1
+        assert classes["served"].get(CLASS_BATCH, 0) >= 3
+        assert classes["weights"] == DEFAULT_CLASS_WEIGHTS
+        tokens = container.metrics.value(
+            "app_tpu_sched_tokens_total", model="m", cls=CLASS_BATCH)
+        assert tokens is not None and tokens >= 4.0
+
+    asyncio.run(main())
