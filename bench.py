@@ -132,6 +132,16 @@ REGRESSION_NOTES = {
         "shared page pool through the registry, mixed SLO classes; "
         "per-model splits (tok_s_big/tok_s_cheap) share one wall clock — "
         "compare within the run, not across rounds"),
+    "llama_disagg_decode_tok_s": (
+        "new in r9 (disaggregated serving): 1 prefill + 1 decode replica "
+        "behind the router, KV shipped over the full kv_wire pack/chunk/"
+        "unpack path — compare against decode_tok_s_monolithic from the "
+        "SAME run, not across rounds; in-proc transport prices the codec "
+        "and the adopt scatter, not a network"),
+    "llama_disagg_transfer_bytes_per_req": (
+        "new in r9: mean packed-KV bytes shipped per migrated request — "
+        "moves with prompt-length mix and codec (bf16 vs int8+scales), "
+        "so pin the workload before reading a delta"),
 }
 
 _LEDGER_PATHS = {
@@ -154,6 +164,9 @@ _LEDGER_PATHS = {
     "multi_model_agg_tok_s": ("multi_model", "aggregate_tok_s"),
     "multi_model_tok_s_big": ("multi_model", "tok_s_big"),
     "multi_model_tok_s_cheap": ("multi_model", "tok_s_cheap"),
+    "llama_disagg_decode_tok_s": ("llama_disagg", "decode_tok_s_disagg"),
+    "llama_disagg_transfer_bytes_per_req": ("llama_disagg",
+                                            "transfer_bytes_per_req"),
 }
 
 
@@ -223,6 +236,7 @@ def main() -> None:
     llama_prefix = _llama_prefix_reuse_bench(on_tpu)
     llama_paged = _llama_paged_kv_bench(on_tpu)
     llama_spec = _llama_speculative_bench(on_tpu)
+    llama_disagg = _llama_disagg_bench(on_tpu)
     multi_model = _multi_model_bench(on_tpu)
     llama7b = _llama7b_int8_bench(on_tpu)
 
@@ -242,6 +256,7 @@ def main() -> None:
         "llama_prefix_reuse": llama_prefix,
         "llama_paged_kv": llama_paged,
         "llama_speculative": llama_spec,
+        "llama_disagg": llama_disagg,
         "multi_model": multi_model,
         "llama7b_int8": llama7b,
     }
@@ -1159,6 +1174,125 @@ def _llama_paged_kv_bench(on_tpu: bool):
                  "greedy outputs prove the gather path, the saving is the "
                  "HBM the pool never reserved. Compare dense vs paged "
                  "within this run, not across rounds"),
+    }
+
+
+def _llama_disagg_bench(on_tpu: bool):
+    """Disaggregated serving (docs/tpu/model-serving.md "Disaggregated
+    serving") vs a monolithic control on the same config and workload:
+    one DENSE prefill replica exports each prompt's KV, the paged decode
+    replica adopts it over the full kv_wire pack → chunk → unpack path
+    (in-proc transport: the codec and the adopt scatter are priced, the
+    network is not), and the router relays the stream. Reports TTFT both
+    ways (disagg TTFT carries the transfer leg), decode tok/s, packed
+    bytes shipped per request, and the determinism contract — greedy
+    outputs bit-identical with ZERO prefill dispatches on the decode
+    replica (`decode_prefill_bucket_tokens` must read 0)."""
+    import time
+
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.cluster import (ClusterRegistry, DisaggRouter,
+                                      InProcTransport)
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    # tiny geometry on CPU keeps the scenario exercised everywhere
+    if on_tpu:
+        preset, max_len, buckets, page, slots = (
+            "small", 512, (32, 64, 128, 256), 32, 8)
+    else:
+        preset, max_len, buckets, page, slots = "tiny", 64, (8, 16), 4, 4
+    cfg = llama.config(preset)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    prompts = [[(5 * i + j) % 250 + 1 for j in range(length)]
+               for i, length in enumerate([b - 2 for b in buckets] * 2)]
+    budget = 8
+
+    def build(paged):
+        container = new_mock_container()
+        kwargs = dict(paged_kv=True) if paged else {}
+        return GenerationEngine(
+            cfg, params, max_slots=slots, max_len=max_len,
+            prompt_buckets=buckets, kv_page=page, steps_per_tick=4,
+            logger=container.logger, metrics=container.metrics, **kwargs)
+
+    async def drive(open_stream):
+        """Sequential closed loop (TTFT needs an uncontended prefill):
+        per-request time-to-first-token plus aggregate tok/s."""
+        outs, ttfts = [], []
+        start = time.perf_counter()
+        for prompt in prompts:
+            t0 = time.perf_counter()
+            stream = await open_stream(prompt)
+            tokens = [await stream.__anext__()]
+            ttfts.append(time.perf_counter() - t0)
+            async for token in stream:
+                tokens.append(token)
+            outs.append(tokens)
+        elapsed = time.perf_counter() - start
+        total = sum(len(o) for o in outs)
+        ttfts.sort()
+        return (outs, total / elapsed if elapsed else None,
+                ttfts[len(ttfts) // 2] * 1000.0)
+
+    async def run_monolithic():
+        engine = build(True)
+        await engine.start()
+        try:
+            # warm pass compiles the executable family off the timed path —
+            # sequential like the timed loop, so the nb=1 prefill variants
+            # the closed loop actually dispatches are the ones compiled
+            for prompt in prompts:
+                await engine.generate(prompt, max_new_tokens=budget)
+            return await drive(
+                lambda p: engine.generate_stream(p, max_new_tokens=budget))
+        finally:
+            await engine.stop()
+
+    async def run_disagg():
+        prefill_eng, decode_eng = build(False), build(True)
+        cluster = ClusterRegistry()
+        cluster.register("p0", "prefill", InProcTransport(prefill_eng))
+        cluster.register("d0", "decode", InProcTransport(decode_eng))
+        router = DisaggRouter(cluster)
+        await decode_eng.start()        # prefill replica needs no loop
+        try:
+            for prompt in prompts:      # warm pass: both executable families
+                await router.generate(prompt, max_new_tokens=budget)
+            result = await drive(
+                lambda p: router.generate_stream(p, max_new_tokens=budget))
+            return result + (router.stats(), decode_eng.stats())
+        finally:
+            await decode_eng.stop()
+
+    mono_outs, mono_tok_s, mono_ttft_ms = asyncio.run(run_monolithic())
+    (dis_outs, dis_tok_s, dis_ttft_ms, router_stats,
+     decode_stats) = asyncio.run(run_disagg())
+
+    requests = router_stats["requests"] or 1
+    return {
+        "preset": preset,
+        "requests_per_pass": len(prompts),
+        "page_tokens": page,
+        # determinism contract: greedy streams identical across the split
+        "token_identical": mono_outs == dis_outs,
+        # zero re-prefill: migrated KV became page-table entries
+        "decode_prefill_bucket_tokens": decode_stats[
+            "prefill_bucket_tokens"],
+        "kv_adoptions": decode_stats["kv_adoptions"],
+        "ttft_ms_monolithic": round(mono_ttft_ms, 1),
+        "ttft_ms_disagg": round(dis_ttft_ms, 1),
+        "decode_tok_s_monolithic": (round(mono_tok_s, 1)
+                                    if mono_tok_s else None),
+        "decode_tok_s_disagg": round(dis_tok_s, 1) if dis_tok_s else None,
+        "transfer_bytes_per_req": round(
+            router_stats["bytes_shipped"] / requests),
+        "note": ("in-proc transport: codec + adopt scatter priced, "
+                 "network not; disagg TTFT carries the transfer leg. "
+                 "Compare monolithic vs disagg within this run, not "
+                 "across rounds"),
     }
 
 

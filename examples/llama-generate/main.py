@@ -6,10 +6,26 @@ Serving engine: slot-based continuous batching (gofr_tpu.tpu.GenerationEngine)
 cache regions without recompiles. Uses the framework BPE tokenizer (C++
 encode path when the toolchain is present).
 
-For tensor parallelism over a slice set ``TPU_MESH=dp:1,tp:8``: the engine
-shards params with gofr_tpu.parallel.llama_param_specs (Megatron column/row
-specs) and the KV cache with llama_cache_specs (slots on dp, kv-heads on
-tp); XLA inserts the all-reduces over ICI.
+For tensor parallelism over a slice set ``MESH=tp:8`` (or ``MESH=8``;
+legacy ``TPU_MESH=dp:1,tp:8`` still works): the engine shards params with
+gofr_tpu.parallel.llama_param_specs (Megatron column/row specs) and the KV
+cache with llama_cache_specs (slots on dp, kv-heads on tp); XLA inserts the
+all-reduces over ICI. The 7B presets default to a sharded mesh over every
+addressable device — a 7B model does not fit one chip's HBM, so monolithic
+single-device serving was never a real deployment; set ``MESH=off`` to
+force the old single-device path.
+
+Disaggregated serving (ISSUE 8): ``CLUSTER_ROLE=prefill|decode|both`` tags
+this replica's phase; ``CLUSTER_PEERS=name=role@url[#grpc],...`` registers
+remote replicas. The replica then exposes the handoff plane —
+``POST /disagg/prefill`` (run prefill, park packed KV in the handoff
+table), ``GET /disagg/fetch`` + gRPC stream ``/gofr.Disagg/fetch`` (pull
+the KV blob, chunked), ``POST /disagg/adopt`` (admit shipped KV pages,
+decode, return tokens) — and ``POST /disagg/generate``, the router
+front-end that prefills on one replica and decodes on another
+(``KV_WIRE_CODEC=auto|bf16|int8`` pins the wire format).
+``POST /disagg/drain`` {"replica": name} drains a replica: routing stops
+immediately, in-flight streams finish, its pool pages come back.
 
 Multi-model serving (ISSUE 7): ``MODELS=big=small>cheap,cheap=tiny,moe=moe``
 registers several named engines behind one ModelRegistry — ``name=preset``
@@ -41,30 +57,47 @@ def build_app():
     import jax
 
     from gofr_tpu.models import llama, moe
-    from gofr_tpu.tpu import (GenerationEngine, ModelRegistry,
-                              ModelUnavailable, PagePool)
-    from gofr_tpu.tpu.sched import parse_class_weights
+    from gofr_tpu.tpu import (ClusterRegistry, DisaggRouter,
+                              GenerationEngine, HTTPTransport,
+                              InProcTransport, ModelRegistry,
+                              ModelUnavailable, NoReplicaAvailable,
+                              PagePool, kv_wire, parse_peers)
+    from gofr_tpu.tpu.cluster import HandoffTable
+    from gofr_tpu.tpu.sched import role_class_weights
 
     app = new_app()
     kv_int8 = os.environ.get("LLAMA_KV_INT8") == "1"
     paged_kv = os.environ.get("GENERATE_PAGED_KV") == "1"
     kv_page = int(os.environ.get("GENERATE_KV_PAGE", "32"))
-    # SLO-class weighted-fair scheduling: admission interleaves deadline
-    # classes by weight (docs/tpu/model-serving.md "SLO classes")
-    class_weights = parse_class_weights(os.environ.get("SLO_CLASS_WEIGHTS"))
+    # disaggregated serving: this replica's phase + the remote fleet
+    cluster_role = os.environ.get("CLUSTER_ROLE", "both").strip() or "both"
+    cluster_peers = parse_peers(os.environ.get("CLUSTER_PEERS"))
+    # SLO-class weighted-fair scheduling, seeded from the replica role
+    # (decode replicas weight migrated-KV traffic highest; explicit
+    # SLO_CLASS_WEIGHTS entries override the preset per class)
+    class_weights = role_class_weights(
+        cluster_role, os.environ.get("SLO_CLASS_WEIGHTS"))
     # speculative decode: a cheap draft proposes GENERATE_SPEC_GAMMA
     # tokens per tick, the target verifies them in one batched forward
     draft_preset = os.environ.get("GENERATE_DRAFT_MODEL")
     spec_gamma = int(os.environ.get("GENERATE_SPEC_GAMMA", "4"))
+    default_preset = os.environ.get("LLAMA_PRESET", "small")
 
     mesh = None
-    if app.config.get("TPU_MESH"):
-        from gofr_tpu.parallel import make_mesh
-        axes = {}
-        for part in str(app.config.get("TPU_MESH")).split(","):
-            axis, _, size = part.partition(":")
-            axes[axis.strip()] = int(size)
-        mesh = make_mesh(axes)
+    mesh_spec = (os.environ.get("MESH")
+                 or app.config.get("TPU_MESH") or "").strip()
+    if mesh_spec.lower() == "off":
+        mesh = None
+    else:
+        from gofr_tpu.parallel import make_mesh, parse_mesh_spec
+        axes = parse_mesh_spec(mesh_spec)
+        if axes is None and default_preset in ("7b", "llama3-8b") \
+                and len(jax.devices()) > 1:
+            # sharded-by-default for the 7B-class presets: tp over the
+            # whole slice (the BASELINE.json v5e-8 serving topology)
+            axes = {"dp": 1, "tp": -1}
+        if axes is not None:
+            mesh = make_mesh(axes)
 
     def model_config(preset):
         """`moe`/`moe-<preset>` → MoE variant; anything else is a llama
@@ -156,13 +189,12 @@ def build_app():
             eng = make_engine(preset, name, seed * 2, seed == 0,
                               page_pool=pool)
             registry.register(name, eng, fallback=fallback,
-                              default=(seed == 0))
+                              default=(seed == 0), role=cluster_role)
         engine = registry.engine()     # default model (admin accessor —
         app.container.tpu = registry   # entries are LOADING until warmup);
         #                                per-model health/statusz/varz/xlaz
     else:
-        preset = os.environ.get("LLAMA_PRESET", "small")
-        engine = make_engine(preset, "generate", 0, True)
+        engine = make_engine(default_preset, "generate", 0, True)
         app.container.tpu = engine  # surfaces engine health at /.well-known
     app.enable_statusz()        # live queue/slot/KV-cache/timeline snapshot
     app.enable_varz()           # windowed SLO/goodput/saturation numbers
@@ -297,11 +329,142 @@ def build_app():
 
         return tokens()
 
+    # -- disaggregated serving plane (ISSUE 8) ------------------------------
+    # handoff table: packed KV parked between /disagg/prefill and the
+    # peer's chunked fetch; cluster registry: local engine under its
+    # CLUSTER_ROLE + every CLUSTER_PEERS entry behind a circuit breaker
+    import asyncio
+    import base64
+
+    from gofr_tpu.http.response import FileResponse
+
+    # KV_WIRE_CODEC=auto|bf16|int8, validated against the pool storage
+    # format at startup — a transcoding mismatch is a deploy error
+    kv_wire.resolve_codec(os.environ.get("KV_WIRE_CODEC", "auto"),
+                          engine.cfg)
+    handoffs = HandoffTable(
+        capacity=int(os.environ.get("DISAGG_HANDOFF_CAPACITY", "64")),
+        ttl_s=float(os.environ.get("DISAGG_HANDOFF_TTL_S", "120")))
+    cluster = ClusterRegistry(logger=app.logger,
+                              metrics=app.container.metrics)
+    cluster.register("local", cluster_role, InProcTransport(engine))
+    for peer_name, peer_role, peer_url, peer_grpc in cluster_peers:
+        cluster.register(
+            peer_name, peer_role,
+            HTTPTransport(peer_url, grpc_target=peer_grpc,
+                          logger=app.logger,
+                          metrics=app.container.metrics,
+                          tracer=app.container.tracer))
+    app.container.cluster = cluster  # role-aware readiness in health()
+    router = DisaggRouter(cluster, logger=app.logger,
+                          metrics=app.container.metrics,
+                          tracer=app.container.tracer)
+
+    def parse_sampling(get):
+        """Sampling from flat key→value accessors (query params or JSON);
+        absent keys fall back to greedy."""
+        seed = get("seed")
+        return Sampling(
+            temperature=float(get("temperature") or 0.0),
+            top_k=int(get("top_k") or 0),
+            top_p=float(get("top_p") or 1.0),
+            seed=int(seed) if seed not in (None, "") else None)
+
+    async def disagg_prefill(ctx):
+        # prefill locally, pack off the event loop, park for pickup
+        await engine.start()
+        data = ctx.bind()
+        try:
+            prompt_ids = [int(t) for t in data["prompt"]]
+            sampling = parse_sampling((data.get("sampling") or {}).get)
+            payload = await engine.prefill_export(prompt_ids,
+                                                  sampling=sampling)
+        except KeyError as exc:
+            raise BadRequest(f"missing field: {exc}") from exc
+        except (TypeError, ValueError) as exc:
+            raise BadRequest(str(exc)) from exc
+        loop = asyncio.get_running_loop()
+        blob = await loop.run_in_executor(None, kv_wire.pack, payload)
+        return {"handoff": handoffs.put(blob), "bytes": len(blob),
+                "payload": payload.describe()}
+
+    async def disagg_fetch(ctx):
+        try:
+            blob = handoffs.get(ctx.param("handoff"))
+        except KeyError as exc:
+            raise BadRequest(str(exc)) from exc
+        return FileResponse(content=blob)
+
+    async def disagg_fetch_grpc(ctx):
+        blob = handoffs.get(ctx.request.payload["handoff"])
+
+        async def chunks():
+            for chunk in kv_wire.iter_chunks(blob):
+                yield {"chunk": base64.b64encode(chunk).decode("ascii")}
+
+        return chunks()
+
+    async def disagg_adopt(ctx):
+        # admit shipped KV pages (zero local prefill), decode to the
+        # budget, return the whole completion — the buffered half of the
+        # handoff; cross-process token streaming stays on gRPC generate
+        await engine.start()
+        blob = ctx.request.body
+        try:
+            max_new = int(ctx.param("max_new_tokens") or 32)
+            eos_raw = ctx.param("eos_id")
+            sampling = parse_sampling(
+                lambda key: ctx.param(key) or None)
+            loop = asyncio.get_running_loop()
+            payload = await loop.run_in_executor(None, kv_wire.unpack, blob)
+            stream = await engine.adopt_kv(
+                payload, max_new, eos_id=int(eos_raw) if eos_raw else None,
+                sampling=sampling,
+                traceparent=ctx.header("traceparent") or None,
+                transfer_bytes=len(blob))
+        except kv_wire.KVWireError as exc:
+            raise BadRequest(str(exc)) from exc
+        except (TypeError, ValueError) as exc:
+            raise BadRequest(str(exc)) from exc
+        tokens = [token async for token in stream]
+        return {"tokens": tokens, "model": engine.model_name}
+
+    async def disagg_generate(ctx):
+        # router front-end: prefill replica → KV handoff → decode replica
+        await engine.start()
+        prompt_ids, max_new, sampling = parse_request(ctx.bind())
+        try:
+            out = await router.generate(prompt_ids, max_new,
+                                        sampling=sampling)
+        except NoReplicaAvailable as exc:
+            raise Unavailable(str(exc)) from exc
+        except kv_wire.KVWireError as exc:
+            raise BadRequest(str(exc)) from exc
+        except ValueError as exc:
+            raise BadRequest(str(exc)) from exc
+        return {"completion": tokenizer.decode(out), "tokens": out,
+                "router": router.stats()}
+
+    async def disagg_drain(ctx):
+        name = (ctx.bind() or {}).get("replica", "local")
+        try:
+            drained = await cluster.drain(name)
+        except KeyError as exc:
+            raise BadRequest(str(exc)) from exc
+        return {"replica": name, "drained": drained,
+                "cluster": cluster.stats()}
+
     app.post("/generate", generate)
     app.post("/generate/stream", generate_stream)
     app.post("/v1/{model}/generate", generate)
     app.post("/v1/{model}/generate/stream", generate_stream)
     app.register_grpc_stream("Llama", "generate", generate_grpc_stream)
+    app.post("/disagg/prefill", disagg_prefill)
+    app.get("/disagg/fetch", disagg_fetch)
+    app.post("/disagg/adopt", disagg_adopt)
+    app.post("/disagg/generate", disagg_generate)
+    app.post("/disagg/drain", disagg_drain)
+    app.register_grpc_stream("Disagg", "fetch", disagg_fetch_grpc)
     return app
 
 

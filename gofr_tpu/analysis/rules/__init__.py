@@ -10,6 +10,7 @@ from gofr_tpu.analysis.rules.gt002_tasks import FireAndForgetRule
 from gofr_tpu.analysis.rules.gt003_recompile import RecompileHazardRule
 from gofr_tpu.analysis.rules.gt004_traced_effects import TracedSideEffectsRule
 from gofr_tpu.analysis.rules.gt005_metrics import MetricDisciplineRule
+from gofr_tpu.analysis.rules.gt006_kv_transfer import KVTransferSyncRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -17,6 +18,7 @@ ALL_RULES = (
     RecompileHazardRule,
     TracedSideEffectsRule,
     MetricDisciplineRule,
+    KVTransferSyncRule,
 )
 
 

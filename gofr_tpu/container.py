@@ -51,6 +51,10 @@ class Container:
         self.file = None
         self.tpu = None
         self.tpu_batcher = None  # created by App.start when tpu is wired
+        # disaggregated serving (ISSUE 8): ClusterRegistry of replica
+        # roles, wired by the example/app when CLUSTER_ROLE/CLUSTER_PEERS
+        # configure a prefill/decode split; folds into health() below
+        self.cluster = None
 
         self._start_time = time.time()
 
@@ -265,6 +269,29 @@ class Container:
             "requests routed to a fallback model (by source model and "
             "fallback taken) — non-zero means degraded or non-READY "
             "routing is active")
+        # disaggregated serving catalog (ISSUE 8): the prefill→decode KV
+        # handoff — how long the wire leg takes, how many bytes it ships,
+        # and how many migrated requests each decode replica admitted
+        metrics.new_histogram(
+            "app_tpu_kv_transfer_seconds",
+            "prefill→decode KV handoff wall time (pack + wire + unpack), "
+            "by transport (inproc|http)",
+            (0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10))
+        metrics.new_updown_counter(
+            "app_tpu_kv_transfer_bytes_total",
+            "KV bytes adopted from remote prefill, per model")
+        metrics.new_counter(
+            "app_tpu_kv_adoptions_total",
+            "migrated requests whose KV was admitted as page-table "
+            "entries (zero decode-side prefill), per model")
+        metrics.new_gauge(
+            "app_tpu_replica_state",
+            "cluster replica lifecycle per (replica, role): 2 READY, "
+            "3 DRAINING — same encoding as app_tpu_model_state")
+        metrics.new_gauge(
+            "app_tpu_replica_inflight",
+            "router-level in-flight requests per replica — what drain "
+            "waits on")
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",
@@ -297,7 +324,7 @@ class Container:
         }
         statuses = []
         for name in ("sql", "redis", "pubsub", "mongo", "cassandra",
-                     "clickhouse", "tpu"):
+                     "clickhouse", "tpu", "cluster"):
             source = getattr(self, name)
             if source is None:
                 continue

@@ -3,7 +3,7 @@ training. TPU-native replacement for the reference's scale-out story
 (SURVEY.md §2.8: Kafka consumer groups + k8s) — shardings are annotated,
 XLA inserts collectives, traffic rides ICI."""
 
-from gofr_tpu.parallel.mesh import make_mesh, serving_mesh
+from gofr_tpu.parallel.mesh import make_mesh, parse_mesh_spec, serving_mesh
 from gofr_tpu.parallel.pipeline import make_pp_forward
 from gofr_tpu.parallel.ring_attention import ring_attention
 from gofr_tpu.parallel.sharding import (
@@ -18,7 +18,7 @@ from gofr_tpu.parallel.sharding import (
 from gofr_tpu.parallel.train import TrainState, make_eval_step, make_train_step
 
 __all__ = [
-    "make_mesh", "serving_mesh", "ring_attention",
+    "make_mesh", "parse_mesh_spec", "serving_mesh", "ring_attention",
     "batch_spec", "bert_param_specs", "llama_cache_specs",
     "llama_param_specs", "prune_specs", "replicated_specs", "shard_pytree",
     "TrainState", "make_eval_step", "make_train_step", "make_pp_forward",

@@ -59,6 +59,41 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
         axis_types=axis_types)
 
 
+def parse_mesh_spec(spec: Optional[str]) -> Optional[Dict[str, int]]:
+    """Parse the ``MESH`` knob into ``make_mesh`` axis sizes.
+
+    Accepted forms: ``"dp:2,tp:4"`` (explicit axes), ``"tp:8"`` (one
+    axis, dp fills the rest), a bare integer ``"8"`` (shorthand for
+    ``tp:<n>`` — the common "shard the model N ways" intent), and
+    ``"auto"`` (tp over every addressable device, dp:1). Returns None
+    for empty/absent specs; malformed axis sizes raise ``ValueError``
+    because a typo'd topology must fail at startup."""
+    spec = (spec or "").strip().lower()
+    if not spec:
+        return None
+    if spec == "auto":
+        return {"dp": 1, "tp": -1}
+    if spec.isdigit():
+        return {"dp": -1, "tp": int(spec)}
+    axes: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        axis, sep, size = part.partition(":")
+        if not sep or not axis.strip():
+            raise ValueError(
+                f"MESH entry {part!r}: expected axis:size (e.g. tp:4)")
+        try:
+            axes[axis.strip()] = int(size)
+        except ValueError:
+            raise ValueError(
+                f"MESH entry {part!r}: size must be an integer") from None
+    if len(axes) == 1 and "tp" in axes:
+        axes = {"dp": -1, "tp": axes["tp"]}
+    return axes or None
+
+
 def serving_mesh(tp: int = 1) -> Mesh:
     """dp×tp mesh: shard the model tp-ways, data-parallel over the rest —
     the v5e-8 serving topology from BASELINE.json (tp=4 or 8 for Llama-7B)."""

@@ -251,10 +251,16 @@ class Watchdog:
                  window_s: float = 60.0, interval_s: float = 5.0,
                  hysteresis: int = 3, min_requests: int = 1,
                  ledger: Any = None,
-                 max_serving_compiles: Optional[int] = None):
+                 max_serving_compiles: Optional[int] = None,
+                 role: str = "both"):
         self.slo = slo
         self.metrics = metrics
         self.logger = logger
+        # disaggregated serving (ISSUE 8): the replica role this watchdog
+        # guards. Labels the health-transition counter and statusz so a
+        # fleet dashboard can tell a sick prefill tier from a sick decode
+        # tier — their remedies differ (add compute vs add HBM).
+        self.role = role
         self.min_attainment = min_attainment
         self.max_p99_ttft_s = max_p99_ttft_s
         # recompile-storm signal (ISSUE 3): a CompileLedger (or anything
@@ -321,7 +327,7 @@ class Watchdog:
         self._good_streak = 0
         if self.metrics is not None:
             self.metrics.increment_counter("app_health_transitions_total",
-                                           to=state)
+                                           to=state, role=self.role)
         if self.logger is not None:
             if state == STATE_DEGRADED:
                 self.logger.warn("watchdog: %s -> %s (%s)", previous, state,
@@ -358,6 +364,7 @@ class Watchdog:
     def statusz(self) -> Dict[str, Any]:
         return {
             "state": self.state,
+            "role": self.role,
             "transitions": self.transitions,
             "bad_streak": self._bad_streak,
             "good_streak": self._good_streak,
@@ -380,13 +387,15 @@ def new_watchdog(config: Any, slo: SLOTracker, metrics: Any = None,
     the TTFT ceiling check is off; attainment defaults to 0.9. With a
     compile ledger wired, ``SLO_MAX_SERVING_COMPILES`` (default 3, 0
     disables) bounds serve-time compiles per window before the replica
-    reports a recompile storm."""
+    reports a recompile storm. ``CLUSTER_ROLE`` labels the watchdog with
+    the replica's serving role (disaggregated topologies)."""
     if not config.get_bool("SLO_WATCHDOG_ENABLED", True):
         return None
     max_ttft_ms = config.get_float("SLO_MAX_P99_TTFT_MS", 0.0)
     max_compiles = int(config.get_float("SLO_MAX_SERVING_COMPILES", 3))
     return Watchdog(
         slo, metrics=metrics, logger=logger,
+        role=config.get_or_default("CLUSTER_ROLE", "both"),
         min_attainment=config.get_float("SLO_MIN_ATTAINMENT", 0.9),
         max_p99_ttft_s=(max_ttft_ms / 1000.0) if max_ttft_ms > 0 else None,
         window_s=config.get_float("SLO_WINDOW_S", 60.0),

@@ -3,7 +3,11 @@ compilation, dynamic batching, continuous-batching generation, per-chip
 health (north star, BASELINE.json). The identical executor runs on the
 CPU backend in tests — the "miniredis of XLA" strategy (SURVEY.md §4)."""
 
+from gofr_tpu.tpu import kv_wire
 from gofr_tpu.tpu.batcher import DynamicBatcher
+from gofr_tpu.tpu.cluster import (ClusterRegistry, DisaggRouter,
+                                  HTTPTransport, InProcTransport,
+                                  NoReplicaAvailable, parse_peers)
 from gofr_tpu.tpu.compile_ledger import (CAUSE_SERVING, CAUSE_WARMUP,
                                          CompileLedger, ShapeStats,
                                          fingerprint_lowered, suggest_ladder)
@@ -18,4 +22,6 @@ __all__ = ["DynamicBatcher", "Executor", "FlightRecorder",
            "DEFAULT_BUCKETS", "CompileLedger", "ShapeStats",
            "CAUSE_WARMUP", "CAUSE_SERVING", "fingerprint_lowered",
            "suggest_ladder", "ModelRegistry", "ModelUnavailable",
-           "PagePool", "HBMBudget"]
+           "PagePool", "HBMBudget", "kv_wire", "ClusterRegistry",
+           "DisaggRouter", "InProcTransport", "HTTPTransport",
+           "NoReplicaAvailable", "parse_peers"]

@@ -1,0 +1,640 @@
+"""Disaggregated serving cluster: replica roles, routing, KV handoff.
+
+ISSUE 8's tentpole. Prefill is compute-bound (one big prompt forward),
+decode is memory-bound (thousands of small batched steps); a monolithic
+replica sizes both phases with one knob. This module lets them live on
+*different replicas*, each tuned to its own batch operating point (the
+batch-size/latency tradeoff study in PAPERS.md, arxiv 1812.11731):
+
+- **Roles** — every replica serves as ``prefill``, ``decode``, or
+  ``both``. A ``both`` replica is a valid target for either phase, so a
+  cluster degrades gracefully to monolithic serving.
+- **ClusterRegistry** — names replicas, tracks READY/DRAINING state and
+  router-level in-flight counts, and picks targets round-robin per role,
+  skipping DRAINING replicas and peers whose circuit breaker
+  (``service/circuit_breaker.py``) is open. ``drain`` stops new routing
+  and waits for in-flight streams; a drained in-proc replica's page-pool
+  free list returns to its idle level because migrated requests release
+  pages through the engine's normal slot teardown.
+- **DisaggRouter** — the request front-end: dispatches the prompt to a
+  prefill replica (one ``prefill_export``), ships the packed
+  :mod:`~gofr_tpu.tpu.kv_wire` payload to a decode replica
+  (``adopt_kv``), and relays the decode replica's token stream. The
+  W3C ``traceparent`` rides both hops, so the prefill span, the
+  ``kv_transfer`` span (bytes shipped, transport kind), and the decode
+  spans land in ONE trace.
+- **Transports** — :class:`InProcTransport` (same-process engines; the
+  payload still round-trips ``pack``/``iter_chunks``/``unpack`` so CI
+  exercises the exact wire path), and :class:`HTTPTransport` (remote
+  peers over ``service/client.py`` + circuit breaker, KV chunks fetched
+  over a ``gofr.Disagg/fetch`` gRPC server-stream when the peer
+  advertises a gRPC target, plain HTTP fetch as the fallback).
+
+The decode replica admits migrated KV as page-table entries — zero
+prefill dispatches (``stats()["prefill_bucket_tokens"]`` does not move),
+which is the property the tier-1 disagg tests assert.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from gofr_tpu.tpu import kv_wire
+from gofr_tpu.tpu.registry import (STATE_DRAINING, STATE_READY,
+                                   _STATE_GAUGE)
+from gofr_tpu.trace import current_span
+from gofr_tpu.trace.tracer import format_traceparent
+
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_BOTH = "both"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_BOTH)
+
+__all__ = [
+    "ROLE_PREFILL", "ROLE_DECODE", "ROLE_BOTH", "ROLES",
+    "NoReplicaAvailable", "HandoffTable", "InProcTransport",
+    "HTTPTransport", "ClusterRegistry", "DisaggRouter", "parse_peers",
+]
+
+
+class NoReplicaAvailable(RuntimeError):
+    """No READY replica serves the requested role (all draining, circuit
+    open, or none registered). 503 semantics for the HTTP layer."""
+
+    status_code = 503
+
+    def __init__(self, role: str):
+        super().__init__(f"no READY replica serves role {role!r}")
+        self.role = role
+
+
+def parse_peers(spec: Optional[str]) -> List[Tuple[str, str, str,
+                                                   Optional[str]]]:
+    """Parse the ``CLUSTER_PEERS`` knob: comma-separated
+    ``name=role@base_url`` entries, each optionally suffixed
+    ``#grpc_host:port`` to advertise the peer's gRPC endpoint for
+    chunked KV fetch, e.g.::
+
+        p0=prefill@http://10.0.0.1:8000#10.0.0.1:9000,d0=decode@http://10.0.0.2:8000
+
+    Malformed entries raise ``ValueError`` — a typo'd cluster topology
+    must fail at startup, not route traffic into the void."""
+    peers: List[Tuple[str, str, str, Optional[str]]] = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, rest = part.partition("=")
+        role, at, url = rest.partition("@")
+        if not eq or not at or not name or not url:
+            raise ValueError(
+                f"CLUSTER_PEERS entry {part!r}: expected name=role@url")
+        role = role.strip().lower()
+        if role not in ROLES:
+            raise ValueError(
+                f"CLUSTER_PEERS entry {part!r}: role must be one of "
+                f"{ROLES}")
+        url, _, grpc_target = url.partition("#")
+        peers.append((name.strip(), role, url.strip(),
+                      grpc_target.strip() or None))
+    return peers
+
+
+class HandoffTable:
+    """Bounded TTL store of packed KV payloads awaiting pickup on a
+    prefill replica. The prefill HTTP response carries only the handoff
+    id + byte count; the (potentially large) blob travels over the
+    chunked fetch stream. Entries expire so an abandoned handoff (router
+    died between prefill and fetch) cannot pin host memory."""
+
+    def __init__(self, capacity: int = 64, ttl_s: float = 120.0):
+        self.capacity = int(capacity)
+        self.ttl_s = float(ttl_s)
+        self._entries: Dict[str, Tuple[float, bytes]] = {}
+
+    def put(self, blob: bytes) -> str:
+        self._sweep()
+        while len(self._entries) >= self.capacity:
+            oldest = min(self._entries, key=lambda k: self._entries[k][0])
+            del self._entries[oldest]
+        handoff = os.urandom(8).hex()
+        self._entries[handoff] = (time.monotonic(), bytes(blob))
+        return handoff
+
+    def get(self, handoff: str) -> bytes:
+        self._sweep()
+        entry = self._entries.get(handoff)
+        if entry is None:
+            raise KeyError(f"unknown or expired handoff {handoff!r}")
+        return entry[1]
+
+    def pop(self, handoff: str) -> None:
+        self._entries.pop(handoff, None)
+
+    def _sweep(self) -> None:
+        cutoff = time.monotonic() - self.ttl_s
+        for key in [k for k, (at, _) in self._entries.items()
+                    if at < cutoff]:
+            del self._entries[key]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class InProcTransport:
+    """Same-process replica (one engine per role inside one container —
+    the CI/smoke topology, and the building block for tests). The
+    payload still runs the full ``pack → iter_chunks → assemble →
+    unpack`` pipeline so the in-proc path exercises byte-identical wire
+    framing; only sockets are skipped."""
+
+    kind = "inproc"
+
+    def __init__(self, engine,
+                 chunk_bytes: int = kv_wire.DEFAULT_CHUNK_BYTES):
+        self.engine = engine
+        self.chunk_bytes = int(chunk_bytes)
+
+    def available(self) -> bool:
+        return True
+
+    async def prefill(self, prompt_ids, sampling,
+                      traceparent: Optional[str] = None) -> bytes:
+        payload = await self.engine.prefill_export(prompt_ids,
+                                                   sampling=sampling)
+        loop = asyncio.get_running_loop()
+        blob = await loop.run_in_executor(None, kv_wire.pack, payload)
+        return kv_wire.assemble(
+            kv_wire.iter_chunks(blob, self.chunk_bytes))
+
+    async def adopt(self, blob: bytes, max_new_tokens: int,
+                    eos_id: Optional[int], sampling,
+                    traceparent: Optional[str] = None,
+                    submitted_at: Optional[float] = None,
+                    transfer_s: float = 0.0):
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(None, kv_wire.unpack, blob)
+        return await self.engine.adopt_kv(
+            payload, max_new_tokens, eos_id=eos_id, sampling=sampling,
+            submitted_at=submitted_at, traceparent=traceparent,
+            transfer_s=transfer_s, transfer_bytes=len(blob))
+
+    def health_check(self) -> Dict[str, Any]:
+        return self.engine.health_check()
+
+    def describe(self) -> Dict[str, Any]:
+        return {"kind": self.kind,
+                "model": getattr(self.engine, "model_name", None)}
+
+
+class HTTPTransport:
+    """Remote replica over the service layer. Control plane rides
+    ``service/client.py`` (traceparent injection, response histogram,
+    circuit breaker); the KV blob is fetched from the prefill peer's
+    handoff table — over a ``gofr.Disagg/fetch`` gRPC server-stream in
+    bounded chunks when the peer advertises ``grpc_target``, over plain
+    HTTP otherwise (the fallback the tentpole requires). The adopt
+    response is buffered JSON (sync-core HTTP client); token *streaming*
+    across processes stays on the existing gRPC generate stream."""
+
+    kind = "http"
+
+    def __init__(self, base_url: str, grpc_target: Optional[str] = None,
+                 service=None, breaker_threshold: int = 5,
+                 breaker_interval: float = 10.0, timeout: float = 120.0,
+                 logger=None, metrics=None, tracer=None):
+        from gofr_tpu.service.circuit_breaker import CircuitBreakerConfig
+        from gofr_tpu.service.client import HTTPService
+        if service is None:
+            service = HTTPService(base_url, logger=logger, metrics=metrics,
+                                  tracer=tracer, timeout=timeout,
+                                  service_name=base_url)
+        self.service = CircuitBreakerConfig(
+            breaker_threshold, breaker_interval).add_option(service)
+        self.grpc_target = grpc_target
+        self.logger = logger
+
+    def available(self) -> bool:
+        return not getattr(self.service, "is_open", False)
+
+    async def prefill(self, prompt_ids, sampling,
+                      traceparent: Optional[str] = None) -> bytes:
+        headers = {"traceparent": traceparent} if traceparent else None
+        response = await self.service.apost(
+            "/disagg/prefill",
+            body={"prompt": [int(t) for t in prompt_ids],
+                  "sampling": _sampling_dict(sampling)},
+            headers=headers)
+        if not response.ok:
+            raise RuntimeError(
+                f"prefill peer answered {response.status_code}: "
+                f"{response.body[:200]!r}")
+        info = response.json()
+        blob = await self._fetch(info["handoff"], headers)
+        if len(blob) != int(info.get("bytes", len(blob))):
+            raise kv_wire.KVWireError(
+                f"handoff fetch returned {len(blob)} bytes, peer "
+                f"declared {info.get('bytes')}")
+        return blob
+
+    async def _fetch(self, handoff: str,
+                     headers: Optional[Dict[str, str]]) -> bytes:
+        if self.grpc_target:
+            try:
+                return await _grpc_fetch(self.grpc_target, handoff)
+            except Exception as exc:
+                if self.logger is not None:
+                    self.logger.warn(
+                        "grpc KV fetch from %s failed (%r); falling back "
+                        "to HTTP", self.grpc_target, exc)
+        response = await self.service.aget(
+            "/disagg/fetch", params={"handoff": handoff}, headers=headers)
+        if not response.ok:
+            raise RuntimeError(
+                f"handoff fetch answered {response.status_code}")
+        return response.body
+
+    async def adopt(self, blob: bytes, max_new_tokens: int,
+                    eos_id: Optional[int], sampling,
+                    traceparent: Optional[str] = None,
+                    submitted_at: Optional[float] = None,
+                    transfer_s: float = 0.0):
+        headers = {"Content-Type": "application/octet-stream"}
+        if traceparent:
+            headers["traceparent"] = traceparent
+        params = {"max_new_tokens": int(max_new_tokens)}
+        if eos_id is not None:
+            params["eos_id"] = int(eos_id)
+        params.update(_sampling_dict(sampling))
+        response = await self.service.apost(
+            "/disagg/adopt", params=params, body=bytes(blob),
+            headers=headers)
+        if not response.ok:
+            raise RuntimeError(
+                f"decode peer answered {response.status_code}: "
+                f"{response.body[:200]!r}")
+        return _ListStream(response.json().get("tokens", []))
+
+    def health_check(self) -> Dict[str, Any]:
+        return self.service.health_check()
+
+    def describe(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "base_url": self.service.base_url,
+                "grpc_target": self.grpc_target,
+                "circuit": "open" if not self.available() else "closed"}
+
+
+def _sampling_dict(sampling) -> Dict[str, Any]:
+    if sampling is None:
+        return {}
+    return {"temperature": float(sampling.temperature),
+            "top_k": int(sampling.top_k),
+            "top_p": float(sampling.top_p),
+            "seed": int(sampling.seed)}
+
+
+async def _grpc_fetch(target: str, handoff: str,
+                      timeout: float = 60.0) -> bytes:
+    """Pull one handoff's chunks over the peer's ``gofr.Disagg/fetch``
+    server-stream (grpcx dynamic JSON framing: each frame is
+    ``{"data": {"chunk": <base64>}}``). Import-gated: no grpcio on the
+    host simply means the HTTP fallback carries the blob."""
+    try:
+        import grpc
+    except ImportError as exc:       # pragma: no cover - env-dependent
+        raise RuntimeError("grpcio is not installed") from exc
+    channel = grpc.aio.insecure_channel(target)
+    try:
+        call = channel.unary_stream(
+            "/gofr.Disagg/fetch",
+            request_serializer=lambda payload: json.dumps(payload).encode(),
+            response_deserializer=lambda raw: json.loads(
+                raw.decode() or "null"))
+        chunks: List[bytes] = []
+        async for frame in call({"handoff": handoff}, timeout=timeout):
+            data = (frame or {}).get("data") or {}
+            chunks.append(base64.b64decode(data.get("chunk", "")))
+        return kv_wire.assemble(chunks)
+    finally:
+        await channel.close()
+
+
+class _ListStream:
+    """Buffered token list behind the TokenStream async-iterator shape —
+    the HTTP adopt response's whole completion, relayed token-wise."""
+
+    def __init__(self, tokens: List[int]):
+        self._tokens = [int(t) for t in tokens]
+        self._i = 0
+
+    def __aiter__(self) -> "_ListStream":
+        return self
+
+    async def __anext__(self) -> int:
+        if self._i >= len(self._tokens):
+            raise StopAsyncIteration
+        token = self._tokens[self._i]
+        self._i += 1
+        return token
+
+    def cancel(self) -> None:
+        self._i = len(self._tokens)
+
+    async def aclose(self) -> None:
+        self.cancel()
+
+
+class Replica:
+    """One registry entry: role, transport, lifecycle state, and the
+    router-level in-flight count drain waits on."""
+
+    __slots__ = ("name", "role", "transport", "state", "inflight",
+                 "requests", "registered_at")
+
+    def __init__(self, name: str, role: str, transport):
+        self.name = name
+        self.role = role
+        self.transport = transport
+        self.state = STATE_READY
+        self.inflight = 0
+        self.requests = 0
+        self.registered_at = time.monotonic()
+
+    def serves(self, role: str) -> bool:
+        return self.role == role or self.role == ROLE_BOTH
+
+    def describe(self) -> Dict[str, Any]:
+        return {"role": self.role, "state": self.state,
+                "inflight": self.inflight, "requests": self.requests,
+                "transport": self.transport.describe()}
+
+
+class ClusterRegistry:
+    """Replica registry with health/drain-aware, per-role round-robin
+    routing. Mirrors the model registry's lifecycle vocabulary
+    (READY/DRAINING and the same state-gauge encoding) so dashboards
+    treat models and replicas uniformly."""
+
+    def __init__(self, logger=None, metrics=None):
+        self.logger = logger
+        self.metrics = metrics
+        self._replicas: Dict[str, Replica] = {}
+        self._rr: Dict[str, int] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def register(self, name: str, role: str, transport) -> Replica:
+        name = str(name)
+        if role not in ROLES:
+            raise ValueError(f"replica role {role!r}: expected one of "
+                             f"{ROLES}")
+        if name in self._replicas:
+            raise ValueError(f"replica {name!r} is already registered")
+        replica = Replica(name, role, transport)
+        self._replicas[name] = replica
+        self._set_state(replica, STATE_READY)
+        if self.logger is not None:
+            self.logger.info("cluster: registered replica %r role=%s "
+                             "transport=%s", name, role,
+                             getattr(transport, "kind", "?"))
+        return replica
+
+    async def drain(self, name: str, timeout_s: float = 30.0,
+                    poll_s: float = 0.05) -> bool:
+        """READY → DRAINING: the router stops picking this replica
+        immediately; then wait for its router-level in-flight streams —
+        and, for an in-proc replica, the engine's own slots/backlog — to
+        finish. Returns True when fully drained in time (state stays
+        DRAINING either way; ``resume`` is the exit)."""
+        replica = self._require(name)
+        self._set_state(replica, STATE_DRAINING)
+        engine = getattr(replica.transport, "engine", None)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            busy = replica.inflight
+            if engine is not None:
+                pending = getattr(engine, "_pending", None)
+                busy = busy or getattr(engine, "active_slots", 0) \
+                    or (pending is not None and not pending.empty())
+            if not busy:
+                return True
+            await asyncio.sleep(poll_s)
+        return False
+
+    def resume(self, name: str) -> None:
+        self._set_state(self._require(name), STATE_READY)
+
+    def _require(self, name: str) -> Replica:
+        replica = self._replicas.get(name)
+        if replica is None:
+            raise KeyError(f"unknown replica {name!r}; registered: "
+                           f"{sorted(self._replicas)}")
+        return replica
+
+    def _set_state(self, replica: Replica, state: str) -> None:
+        replica.state = state
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_tpu_replica_state", _STATE_GAUGE[state],
+                replica=replica.name, role=replica.role)
+
+    # -- routing ------------------------------------------------------------
+    def pick(self, role: str) -> Replica:
+        """Round-robin over READY replicas serving ``role`` (a ``both``
+        replica serves either phase), skipping peers whose circuit is
+        open. Raises :class:`NoReplicaAvailable` when none qualify."""
+        candidates = [r for r in self._replicas.values()
+                      if r.state == STATE_READY and r.serves(role)
+                      and r.transport.available()]
+        if not candidates:
+            raise NoReplicaAvailable(role)
+        turn = self._rr.get(role, 0)
+        self._rr[role] = turn + 1
+        return candidates[turn % len(candidates)]
+
+    def note_start(self, replica: Replica) -> None:
+        replica.inflight += 1
+        replica.requests += 1
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_tpu_replica_inflight",
+                                   float(replica.inflight),
+                                   replica=replica.name)
+
+    def note_end(self, replica: Replica) -> None:
+        replica.inflight = max(0, replica.inflight - 1)
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_tpu_replica_inflight",
+                                   float(replica.inflight),
+                                   replica=replica.name)
+
+    # -- observability ------------------------------------------------------
+    def replicas(self) -> List[str]:
+        return sorted(self._replicas)
+
+    def roles(self) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {ROLE_PREFILL: [], ROLE_DECODE: []}
+        for name, replica in self._replicas.items():
+            for role in (ROLE_PREFILL, ROLE_DECODE):
+                if replica.serves(role):
+                    out[role].append(name)
+        return {role: sorted(names) for role, names in out.items()}
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "replicas": {name: replica.describe()
+                         for name, replica in self._replicas.items()},
+            "roles": self.roles(),
+        }
+
+    def health_check(self) -> Dict[str, Any]:
+        """Role-aware readiness: the cluster is UP only while every
+        role has at least one routable replica — a fleet of healthy
+        decode replicas with zero prefill capacity serves nothing."""
+        details: Dict[str, Any] = {"replicas": {}, "roles": {}}
+        for name, replica in self._replicas.items():
+            health = replica.transport.health_check()
+            details["replicas"][name] = {
+                "role": replica.role, "state": replica.state,
+                "inflight": replica.inflight,
+                "transport": health.get("status", "UNKNOWN"),
+            }
+        status = "UP"
+        for role in (ROLE_PREFILL, ROLE_DECODE):
+            routable = [
+                name for name, replica in self._replicas.items()
+                if replica.state == STATE_READY and replica.serves(role)
+                and replica.transport.available()
+                and details["replicas"][name]["transport"] == "UP"]
+            details["roles"][role] = routable
+            if not routable:
+                status = "DOWN"
+        return {"status": status, "details": details}
+
+
+class _RelayStream:
+    """Router-side wrapper around the decode replica's token stream:
+    releases the registry's in-flight count exactly once, on completion,
+    error, or cancellation — the count ``drain`` waits on."""
+
+    def __init__(self, inner, registry: ClusterRegistry,
+                 replica: Replica):
+        self._inner = inner
+        self._registry = registry
+        self._replica = replica
+        self._open = True
+
+    def __aiter__(self) -> "_RelayStream":
+        return self
+
+    async def __anext__(self) -> int:
+        try:
+            return await self._inner.__anext__()
+        except BaseException:
+            self._finish()
+            raise
+
+    def _finish(self) -> None:
+        if self._open:
+            self._open = False
+            self._registry.note_end(self._replica)
+
+    def cancel(self) -> None:
+        cancel = getattr(self._inner, "cancel", None)
+        if cancel is not None:
+            cancel()
+        self._finish()
+
+    async def aclose(self) -> None:
+        self.cancel()
+
+
+class DisaggRouter:
+    """Request front-end for a disaggregated cluster: admit, prefill on
+    one replica, hand the KV to another, stream tokens back. The
+    transfer leg is measured (``app_tpu_kv_transfer_seconds`` /
+    ``..._bytes_total``) and traced (``kv_transfer`` span carrying bytes
+    shipped and both replica names)."""
+
+    def __init__(self, registry: ClusterRegistry, logger=None,
+                 metrics=None, tracer=None):
+        self.registry = registry
+        self.logger = logger
+        self.metrics = metrics
+        self.tracer = tracer
+        self._requests = 0
+        self._bytes_shipped = 0
+
+    async def generate_stream(self, prompt_ids, max_new_tokens: int,
+                              eos_id: Optional[int] = None,
+                              sampling=None):
+        """Returns an async token iterator (TokenStream shape, ``cancel``
+        / ``aclose`` supported). Routing/validation failures raise here,
+        before any stream bytes are written — same contract as
+        ``GenerationEngine.generate_stream``."""
+        submitted_at = time.monotonic()
+        prefiller = self.registry.pick(ROLE_PREFILL)
+        decoder = self.registry.pick(ROLE_DECODE)
+        parent = current_span() if self.tracer is not None else None
+        span = (self.tracer.start_span("kv_transfer", parent=parent)
+                if self.tracer is not None else None)
+        traceparent = format_traceparent(span) if span is not None else None
+        start = time.perf_counter()
+        self.registry.note_start(prefiller)
+        try:
+            blob = await prefiller.transport.prefill(
+                prompt_ids, sampling, traceparent=traceparent)
+        except BaseException:
+            if span is not None:
+                span.set_status("ERROR")
+                span.finish()
+            raise
+        finally:
+            self.registry.note_end(prefiller)
+        self.registry.note_start(decoder)
+        try:
+            stream = await decoder.transport.adopt(
+                blob, max_new_tokens, eos_id, sampling,
+                traceparent=traceparent, submitted_at=submitted_at,
+                transfer_s=time.perf_counter() - start)
+        except BaseException:
+            self.registry.note_end(decoder)
+            if span is not None:
+                span.set_status("ERROR")
+                span.finish()
+            raise
+        elapsed = time.perf_counter() - start
+        self._requests += 1
+        self._bytes_shipped += len(blob)
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_tpu_kv_transfer_seconds", elapsed,
+                transport=decoder.transport.kind)
+        if span is not None:
+            span.set_attribute("bytes", len(blob))
+            span.set_attribute("prefill_replica", prefiller.name)
+            span.set_attribute("decode_replica", decoder.name)
+            span.set_attribute("transport", decoder.transport.kind)
+            span.finish()
+        return _RelayStream(stream, self.registry, decoder)
+
+    async def generate(self, prompt_ids, max_new_tokens: int,
+                       eos_id: Optional[int] = None,
+                       sampling=None) -> List[int]:
+        stream = await self.generate_stream(prompt_ids, max_new_tokens,
+                                            eos_id=eos_id,
+                                            sampling=sampling)
+        tokens: List[int] = []
+        async for token in stream:
+            tokens.append(token)
+        return tokens
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "requests": self._requests,
+            "bytes_shipped": self._bytes_shipped,
+            "cluster": self.registry.stats(),
+        }

@@ -33,7 +33,8 @@ class RequestRecord:
                  "wall_enqueued_at", "enqueued_at", "admitted_at",
                  "first_token_at", "finished_at", "tokens", "status",
                  "ticks", "batch_min", "batch_max", "batch_sum",
-                 "cached_prefix_len", "pages_held")
+                 "cached_prefix_len", "pages_held", "kv_transfer_s",
+                 "kv_transfer_bytes")
 
     def __init__(self, model: str = "generate", prompt_len: int = 0,
                  budget: int = 0, trace_id: Optional[str] = None,
@@ -56,6 +57,10 @@ class RequestRecord:
         self.batch_sum = 0
         self.cached_prefix_len = 0   # prompt tokens served from prefix KV
         self.pages_held = 0          # KV pool pages mapped (paged engine)
+        # disaggregated handoff (ISSUE 8): wire cost of a migrated
+        # request's KV transfer — zero for locally prefilled requests
+        self.kv_transfer_s = 0.0
+        self.kv_transfer_bytes = 0
 
     # -- event hooks (engine/batcher call these) ---------------------------
     def admitted(self) -> None:
@@ -113,6 +118,9 @@ class RequestRecord:
             "enqueued_at": self.wall_enqueued_at,
             "queue_wait_s": _round(self.queue_wait_s),
             "ttft_s": _round(self.ttft_s),
+            "kv_transfer_s": (_round(self.kv_transfer_s)
+                              if self.kv_transfer_bytes else None),
+            "kv_transfer_bytes": self.kv_transfer_bytes or None,
             "tokens": self.tokens,
             "tokens_per_s": _round(self.tokens_per_s),
             "batch_sizes": {

@@ -526,6 +526,11 @@ class GenerationEngine:
         self._spec_paged_fns: Dict[Tuple[int, int], Any] = {}
         self._draft_prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._draft_insert_fns: Dict[Tuple[int, int], Any] = {}
+        # disaggregated serving (ISSUE 8): page-adoption scatter keyed by
+        # page count, plus export/adopt counters for the handoff proof
+        self._adopt_fns: Dict[int, Any] = {}
+        self._kv_exports = 0
+        self._kv_adoptions = 0
         self._prefill_bucket_tokens = 0   # bucket rows*cols dispatched to
         self._prefill_real_tokens = 0     # prefill vs real prompt tokens
         self._prefix = None
@@ -797,6 +802,36 @@ class GenerationEngine:
 
             fn = jax.jit(insert, donate_argnums=(0, 6, 7, 8, 9, 10, 11))
             self._insert_paged_fns[(nb, lb, plen)] = fn
+        return fn
+
+    def _adopt_fn(self, n_pages: int):
+        """Disaggregated handoff (ISSUE 8): scatter ``n_pages`` migrated
+        KV pages — shipped by a prefill replica, already page-shaped on
+        host — into this engine's pool plus the adopting slot's device
+        rows (cache_len, last_token, sampling state), in one donating
+        executable per page count. No prompt forward runs here: adoption
+        is a memcpy-class operation, which is what keeps
+        ``prefill_bucket_tokens`` at zero for migrated requests."""
+        fn = self._adopt_fns.get(n_pages)
+        if fn is None:
+            jax = self._jax
+
+            def adopt(pool, pages, ids, slot, length, first, cache_len,
+                      last_token, temps, top_ks, top_ps, sample_keys,
+                      new_t, new_k, new_p, new_key):
+                pool = {name: pool[name].at[:, ids].set(pages[name])
+                        for name in pool}
+                cache_len = cache_len.at[slot].set(length)
+                last_token = last_token.at[slot].set(first)
+                temps = temps.at[slot].set(new_t)
+                top_ks = top_ks.at[slot].set(new_k)
+                top_ps = top_ps.at[slot].set(new_p)
+                sample_keys = sample_keys.at[slot].set(new_key)
+                return (pool, cache_len, last_token, temps, top_ks,
+                        top_ps, sample_keys)
+
+            fn = jax.jit(adopt, donate_argnums=(0, 6, 7, 8, 9, 10, 11))
+            self._adopt_fns[n_pages] = fn
         return fn
 
     def _decode_paged_fn(self, k_steps: int, sampled: bool = False,
@@ -1382,6 +1417,287 @@ class GenerationEngine:
         self._wake.set()
         return TokenStream(self, queue, future)
 
+    # -- disaggregated serving: prefill export / KV adoption (ISSUE 8) ------
+    async def prefill_export(self, prompt_ids,
+                             sampling: Optional[Sampling] = None):
+        """Prefill-replica half of the disaggregated handoff: run the
+        prompt forward ONCE and export its KV as a page-aligned
+        :class:`~gofr_tpu.tpu.kv_wire.KVPayload` instead of inserting it
+        into a local slot. The payload carries the first sampled token
+        and the advanced PRNG key, so the adopting decode replica
+        continues token-identically without recomputing a single prompt
+        position. No slot is claimed and the engine loop does not need
+        to be running — exports ride the same compiled ``_prefill_fn``
+        family the local admission path uses, so a replica serving role
+        ``both`` shares its warm executables with local traffic.
+
+        Works for dense and paged engines alike (export reads the
+        prefill's small cache, never the pool): a prefill-only replica
+        can run dense with ``max_len`` = largest bucket while its decode
+        peers run paged."""
+        from gofr_tpu.tpu import kv_wire
+        sampling = sampling or Sampling()
+        prompt, bucket = self._validate(prompt_ids, 1)
+        page = self.kv_page
+        n_pages = -(-len(prompt) // page)
+        jnp, cfg = self._jnp, self.cfg
+        parent = current_span() if self.tracer is not None else None
+        span = (self.tracer.start_span("prefill.export", parent=parent)
+                if self.tracer is not None else None)
+        record = RequestRecord(
+            model=self.model_name, prompt_len=len(prompt), budget=1,
+            trace_id=span.trace_id if span is not None else None,
+            span_id=span.span_id if span is not None else None)
+        self.recorder.start(record)
+        record.admitted()
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        fn = self._prefill_fn(1, bucket)
+        codec = kv_wire.codec_for_cfg(cfg)
+        names = kv_wire.leaf_names(codec)
+        span_tokens = n_pages * page
+
+        def export():
+            # host staging (np.asarray both ways) lives entirely in this
+            # closure — it runs on a worker thread via run_in_executor
+            lengths = np.asarray([len(prompt)], np.int32)
+            temps = np.asarray([max(sampling.temperature, 0.0)],
+                               np.float32)
+            top_ks = np.asarray([sampling.top_k], np.int32)
+            top_ps = np.asarray([sampling.top_p], np.float32)
+            seeds = np.asarray([sampling.seed & 0xFFFFFFFF], np.uint32)
+            first, small, keys = fn(
+                self.params, jnp.asarray(padded), jnp.asarray(lengths),
+                jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), jnp.asarray(seeds))
+            # device->host staging happens in THIS worker thread, never
+            # on the event loop (graftcheck GT006): the whole closure is
+            # dispatched via run_in_executor below
+            host = {}
+            for name in names:
+                leaf = np.asarray(small[name])[:, 0]   # (L, bucket, ...)
+                shape = (leaf.shape[0], span_tokens) + leaf.shape[2:]
+                out = np.zeros(shape, leaf.dtype)
+                if name in ("ks", "vs"):
+                    out[:] = 1.0   # pool scale planes initialize to ones
+                copy = min(span_tokens, leaf.shape[1])
+                out[:, :copy] = leaf[:, :copy]
+                # tail rows past the prompt are attention-masked by
+                # cache_len downstream; zeros here, garbage in the
+                # monolithic path — either way they never contribute
+                host[name] = out.reshape(
+                    (out.shape[0], n_pages, page) + out.shape[2:])
+            key_row = np.asarray(keys)[0]
+            return (int(np.asarray(first)[0]), host,
+                    (int(key_row[0]), int(key_row[1])))
+
+        loop = asyncio.get_running_loop()
+        first, host, key = await loop.run_in_executor(None, export)
+        self._prefills += 1
+        self._prefill_bucket_tokens += bucket
+        self._prefill_real_tokens += len(prompt)
+        self._kv_exports += 1
+        record.first_token()
+        record.tokens = 1
+        self.recorder.finish(record, "exported")
+        if span is not None:
+            span.set_attribute("prompt_len", len(prompt))
+            span.set_attribute("bucket", bucket)
+            span.set_attribute("pages", n_pages)
+            span.finish()
+        return kv_wire.KVPayload(
+            codec=codec, dtype=host["k"].dtype.name, page=page,
+            tokens=len(prompt), n_layers=cfg.n_layers,
+            n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
+            n_pages=n_pages, first_token=first, sample_key=key,
+            model=self.model_name, leaves=host)
+
+    async def adopt_kv(self, payload, max_new_tokens: int,
+                       eos_id: Optional[int] = None,
+                       sampling: Optional[Sampling] = None,
+                       submitted_at: Optional[float] = None,
+                       traceparent: Optional[str] = None,
+                       transfer_s: float = 0.0,
+                       transfer_bytes: int = 0) -> TokenStream:
+        """Decode-replica half of the handoff: admit an exported
+        :class:`~gofr_tpu.tpu.kv_wire.KVPayload` straight into the page
+        pool as page-table entries and start decoding from its first
+        token — zero prefill dispatches (``prefill_bucket_tokens`` does
+        not move). The pages are allocated at refcount 1 exactly like a
+        local admission; the slot releases them through the normal
+        ``_release_slot_kv`` path, so drain/free-list accounting cannot
+        tell a migrated request from a local one.
+
+        ``traceparent`` stitches the remote prefill trace across the
+        hop; ``transfer_s``/``transfer_bytes`` let the transport surface
+        the wire cost on this request's flight record and the
+        ``app_tpu_kv_transfer_*`` series. Raises :class:`KVWireError`
+        on geometry/codec mismatch and ``RuntimeError`` when no slot or
+        pages are free (router backpressure, not a request error)."""
+        from gofr_tpu.tpu import kv_wire
+        from gofr_tpu.tpu.sched import CLASS_MIGRATED
+        if not self.paged:
+            raise ValueError("adopt_kv needs paged_kv=True (migrated KV "
+                             "is admitted as page-table entries)")
+        sampling = sampling or Sampling()
+        cfg = self.cfg
+        if payload.page != self.kv_page:
+            raise kv_wire.KVWireError(
+                f"payload page size {payload.page} != engine kv_page "
+                f"{self.kv_page}")
+        if (payload.n_layers, payload.n_kv_heads, payload.head_dim) != \
+                (cfg.n_layers, cfg.n_kv_heads, cfg.head_dim):
+            raise kv_wire.KVWireError(
+                f"payload geometry (L={payload.n_layers}, "
+                f"Hkv={payload.n_kv_heads}, Dh={payload.head_dim}) does "
+                f"not match this model")
+        if payload.codec != kv_wire.codec_for_cfg(cfg):
+            raise kv_wire.KVWireError(
+                f"payload codec {payload.codec} does not match the pool "
+                "storage format (no transcoding on adopt)")
+        if max_new_tokens < 1:
+            raise ValueError("adopt_kv needs max_new_tokens >= 1")
+        if payload.tokens + max_new_tokens > self.max_len:
+            raise ValueError("migrated prompt + max_new_tokens exceeds "
+                             "cache length")
+        need = payload.n_pages
+        if need + self._kv_reserve > self._pool.num_pages:
+            raise RuntimeError(
+                f"migrated prompt needs {need} KV pages but the pool "
+                f"holds {self._pool.num_pages} (reserve "
+                f"{self._kv_reserve}); it can never be adopted")
+        if not self._free:
+            raise RuntimeError("no free slot to adopt migrated KV into")
+        while (self._pool.free_pages - need < self._kv_reserve
+                and self._prefix is not None and self._prefix.evict_one()):
+            pass
+        if self._pool.free_pages - need < self._kv_reserve:
+            raise RuntimeError(
+                f"kv page pool short for adoption: {need} pages wanted, "
+                f"{self._pool.free_pages} free (reserve "
+                f"{self._kv_reserve})")
+        ids = self._pool.alloc(
+            need, reclaim=(self._prefix.evict_one
+                           if self._prefix is not None else None))
+        if ids is None:
+            raise RuntimeError(
+                f"kv page pool exhausted at adoption: {need} pages "
+                f"wanted, {self._pool.free_pages} free")
+
+        # observability: the adopt span joins the remote prefill trace
+        # when the transport forwarded a traceparent
+        span = None
+        if self.tracer is not None:
+            from gofr_tpu.trace.tracer import extract_traceparent
+            remote = extract_traceparent(traceparent) if traceparent \
+                else None
+            span = self.tracer.start_span(
+                "kv_adopt", remote_parent=remote,
+                parent=None if remote else current_span())
+            span.set_attribute("tokens", payload.tokens)
+            span.set_attribute("pages", need)
+            if transfer_bytes:
+                span.set_attribute("transfer_bytes", transfer_bytes)
+        record = RequestRecord(
+            model=self.model_name, prompt_len=payload.tokens,
+            budget=max_new_tokens,
+            trace_id=span.trace_id if span is not None else None,
+            span_id=span.span_id if span is not None else None)
+        self.recorder.start(record)
+        record.admitted()
+        record.pages_held = need
+        record.kv_transfer_s = float(transfer_s)
+        record.kv_transfer_bytes = int(transfer_bytes)
+        if self.metrics is not None and transfer_bytes:
+            self.metrics.delta_updown_counter(
+                "app_tpu_kv_transfer_bytes_total", float(transfer_bytes),
+                model=self.model_name)
+
+        # claim the slot synchronously (no awaits between here and the
+        # table write: admission and ticks must never see a half-claimed
+        # slot). active stays False until the pages land on device.
+        queue: asyncio.Queue = asyncio.Queue()
+        future = asyncio.get_running_loop().create_future()
+        slot_idx = self._free.pop()
+        slot = self._slots[slot_idx]
+        slot.future = future
+        slot.submitted_at = (submitted_at if submitted_at is not None
+                             else time.monotonic())
+        slot.deadline = current_deadline()
+        slot.remaining = max_new_tokens
+        slot.eos_id = eos_id
+        slot.tokens = []
+        slot.active = False
+        slot.gen += 1
+        gen = slot.gen
+        slot.inflight = 1          # the shipped first token
+        slot.queue = queue
+        slot.temperature = sampling.temperature
+        slot.cls = CLASS_MIGRATED
+        slot.spec_proposed = 0
+        slot.spec_accepted = 0
+        slot.fill = payload.tokens
+        slot.nodes = []
+        slot.pages = list(ids)
+        slot.record = record
+        slot.req_span = span
+        slot.phase_span = None     # decode span opens at the first push
+        for j, pid in enumerate(ids):
+            self._table[slot_idx, j] = pid
+        self._table_version += 1
+
+        fn = self._adopt_fn(need)
+
+        def upload(jnp=self._jnp):
+            # H2D of the migrated pages + the donating scatter, under the
+            # pool lock like every other pool-aliasing dispatch. Always
+            # off-loop: the host->device copy of n_pages*page_bytes is
+            # too big to run inline even warm.
+            idx = np.asarray(ids, np.int32)
+            key = np.asarray(payload.sample_key, np.uint32)
+            with self._pool.lock:
+                pages = {name: jnp.asarray(payload.leaves[name])
+                         for name in payload.leaves}
+                (leaves, self.cache_len, self.last_token, self.temps,
+                 self.top_ks, self.top_ps, self.sample_keys) = fn(
+                    self._pool.leaves, pages, jnp.asarray(idx),
+                    np.int32(slot_idx), np.int32(payload.tokens),
+                    np.int32(payload.first_token),
+                    self.cache_len, self.last_token, self.temps,
+                    self.top_ks, self.top_ps, self.sample_keys,
+                    np.float32(max(sampling.temperature, 0.0)),
+                    np.int32(sampling.top_k),
+                    np.float32(sampling.top_p), jnp.asarray(key))
+                self._pool.leaves = leaves
+            self._pool.note_writes(need)
+
+        try:
+            await asyncio.get_running_loop().run_in_executor(None, upload)
+        except BaseException:
+            slot.gen += 1
+            slot.queue = None
+            slot.future = None
+            self._release_slot_kv(slot_idx, slot)
+            self._finish_slot(slot, "error")
+            self._free.append(slot_idx)
+            if span is not None:
+                span.set_status("ERROR")
+                span.finish()
+            raise
+        slot.active = True
+        self._kv_adoptions += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_kv_adoptions_total", model=self.model_name)
+        self._wake.set()
+        # publish the shipped first token through the normal path: TTFT,
+        # eos/budget bookkeeping, and immediate finish all behave exactly
+        # as if a local prefill fetch had just landed
+        self._push_tokens(slot_idx, gen, [payload.first_token])
+        if span is not None:
+            span.finish()
+        return TokenStream(self, queue, future)
+
     def _cancel_stream(self, queue: asyncio.Queue) -> None:
         """Abandon the request bound to ``queue``: free its slot (in-flight
         tick tokens are dropped via the generation counter) or, if not yet
@@ -1423,6 +1739,11 @@ class GenerationEngine:
                # former for the same admitted traffic
                "prefill_bucket_tokens": self._prefill_bucket_tokens,
                "prefill_real_tokens": self._prefill_real_tokens,
+               # disaggregated handoff accounting: exports are prompt
+               # forwards shipped out, adoptions are migrated prompts
+               # admitted with ZERO local prefill dispatches
+               "kv_exports": self._kv_exports,
+               "kv_adoptions": self._kv_adoptions,
                "max_len": self.max_len,
                "window_ladder": [w or self.max_len
                                  for w in self._window_ladder],

@@ -1,0 +1,289 @@
+"""KV handoff wire codec for disaggregated serving (ISSUE 8).
+
+A prefill replica ships a finished prompt's KV to a decode replica as
+page-aligned pool rows — the same ``(L, n_pages, page, Hkv, Dh)`` layout
+:class:`~gofr_tpu.tpu.page_pool.PagePool` leaves use on device, so the
+receiver admits the payload as page-table entries without reshaping or
+re-prefilling (``prefill_bucket_tokens`` stays 0 on the decode side).
+
+The format dodges the tensor-payload pitfalls the gRPC micro-benchmark
+study documents (PAPERS.md, arxiv 1804.01138): leaves travel as raw
+little-endian buffers behind one fixed-layout header — no per-element
+boxing, one copy at ``tobytes()`` and one at ``frombuffer`` — and
+:func:`iter_chunks` splits the blob into bounded messages so a 7B
+prompt's KV never lands as a single oversized RPC frame.
+
+Layout (all little-endian, no padding)::
+
+    magic "GKVW" | version u16 | codec u8 | flags u8 | page u16
+    | tokens u32 | n_layers u16 | n_kv_heads u16 | head_dim u16
+    | n_pages u32 | first_token i32 | key0 u32 | key1 u32
+    | dtype_len u8 | dtype utf-8 | model_len u8 | model utf-8
+    then per leaf (order fixed by codec): nbytes u64 | raw buffer
+
+Codec 0 (``CODEC_RAW``) carries ``k``/``v`` in the pool dtype
+(bf16 by default); codec 1 (``CODEC_INT8``) carries int8 ``k``/``v``
+plus the f32 ``ks``/``vs`` scale planes. Decoding is strict: a bad
+magic, unknown version/codec, truncated buffer, size mismatch, or
+trailing bytes all raise :class:`KVWireError` — a corrupt handoff must
+fail loudly before it poisons a decode replica's pool.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterable, Iterator, List, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CODEC_RAW", "CODEC_INT8", "KVPayload", "KVWireError",
+    "codec_for_cfg", "resolve_codec", "leaf_names", "leaf_shape",
+    "pack", "unpack", "iter_chunks", "assemble", "DEFAULT_CHUNK_BYTES",
+]
+
+MAGIC = b"GKVW"
+VERSION = 1
+CODEC_RAW = 0    # k/v in the pool dtype (bf16 unless cfg overrides)
+CODEC_INT8 = 1   # int8 k/v + float32 ks/vs scale planes
+
+# gRPC defaults cap messages at 4 MiB; 256 KiB chunks keep each frame
+# far under the cap and let the receiver overlap reassembly with I/O
+DEFAULT_CHUNK_BYTES = 256 << 10
+
+# magic, version, codec, flags, page, tokens, n_layers, n_kv_heads,
+# head_dim, n_pages, first_token, key0, key1
+_HEAD = struct.Struct("<4sHBBHIHHHIiII")
+_SIZE = struct.Struct("<Q")
+
+
+class KVWireError(ValueError):
+    """Malformed/incompatible KV wire payload. 400-class semantics: the
+    sender shipped something this replica must refuse to adopt."""
+
+    status_code = 400
+
+
+class KVPayload:
+    """One prompt's exported KV: geometry header + host leaf buffers
+    shaped ``(L, n_pages, page, Hkv, Dh)`` (scale planes drop the last
+    axis). ``first_token`` is the token the prefill executable already
+    sampled; ``sample_key`` the advanced per-request PRNG key decode
+    continues from — shipping both is what makes the handoff
+    zero-re-prefill AND token-identical."""
+
+    __slots__ = ("codec", "dtype", "page", "tokens", "n_layers",
+                 "n_kv_heads", "head_dim", "n_pages", "first_token",
+                 "sample_key", "model", "leaves")
+
+    def __init__(self, codec: int, dtype: str, page: int, tokens: int,
+                 n_layers: int, n_kv_heads: int, head_dim: int,
+                 n_pages: int, first_token: int,
+                 sample_key: Tuple[int, int], model: str,
+                 leaves: Dict[str, Any]):
+        self.codec = int(codec)
+        self.dtype = str(dtype)
+        self.page = int(page)
+        self.tokens = int(tokens)
+        self.n_layers = int(n_layers)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.n_pages = int(n_pages)
+        self.first_token = int(first_token)
+        self.sample_key = (int(sample_key[0]), int(sample_key[1]))
+        self.model = str(model)
+        self.leaves = leaves
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "codec": "int8" if self.codec == CODEC_INT8 else "raw",
+            "dtype": self.dtype,
+            "page": self.page,
+            "tokens": self.tokens,
+            "n_pages": self.n_pages,
+            "model": self.model,
+        }
+
+
+def codec_for_cfg(cfg) -> int:
+    """The only codec a pool built from ``cfg`` can adopt without
+    transcoding (the wire never requantizes)."""
+    return CODEC_INT8 if getattr(cfg, "kv_int8", False) else CODEC_RAW
+
+
+def resolve_codec(name: str, cfg) -> int:
+    """Map the ``KV_WIRE_CODEC`` knob to a codec id, validated against
+    the pool's storage format: ``auto`` follows the config; asking for a
+    codec the pool cannot hold is a deploy-time config error, not a
+    per-request surprise."""
+    name = (name or "auto").strip().lower()
+    want = codec_for_cfg(cfg)
+    if name == "auto":
+        return want
+    if name in ("bf16", "raw"):
+        asked = CODEC_RAW
+    elif name == "int8":
+        asked = CODEC_INT8
+    else:
+        raise ValueError(
+            f"KV_WIRE_CODEC={name!r}: expected auto, bf16, or int8")
+    if asked != want:
+        raise ValueError(
+            f"KV_WIRE_CODEC={name!r} does not match the pool storage "
+            f"format ({'int8' if want == CODEC_INT8 else 'bf16'}); the "
+            "wire ships pool rows verbatim and never transcodes")
+    return asked
+
+
+def leaf_names(codec: int) -> Tuple[str, ...]:
+    if codec == CODEC_RAW:
+        return ("k", "v")
+    if codec == CODEC_INT8:
+        return ("k", "v", "ks", "vs")
+    raise KVWireError(f"unknown KV wire codec {codec}")
+
+
+def leaf_shape(payload: "KVPayload", name: str) -> Tuple[int, ...]:
+    base = (payload.n_layers, payload.n_pages, payload.page,
+            payload.n_kv_heads)
+    if name in ("ks", "vs"):
+        return base
+    return base + (payload.head_dim,)
+
+
+def _leaf_dtype(payload: "KVPayload", name: str):
+    if payload.codec == CODEC_INT8:
+        return np.dtype(np.float32 if name in ("ks", "vs") else np.int8)
+    return _resolve_dtype(payload.dtype)
+
+
+def _resolve_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError, TypeError):
+        raise KVWireError(f"unknown leaf dtype {name!r}") from None
+
+
+def pack(payload: KVPayload) -> bytes:
+    """Serialize a payload. Leaves must already be host ``np.ndarray``s
+    in the canonical page layout; the caller (the engine's export path)
+    stages device→host off the event loop."""
+    names = leaf_names(payload.codec)
+    missing = [n for n in names if n not in payload.leaves]
+    if missing:
+        raise KVWireError(f"payload lacks leaves {missing}")
+    dtype_b = payload.dtype.encode("utf-8")
+    model_b = payload.model.encode("utf-8")
+    if len(dtype_b) > 255 or len(model_b) > 255:
+        raise KVWireError("dtype/model names exceed 255 bytes")
+    parts: List[bytes] = [
+        _HEAD.pack(MAGIC, VERSION, payload.codec, 0, payload.page,
+                   payload.tokens, payload.n_layers, payload.n_kv_heads,
+                   payload.head_dim, payload.n_pages,
+                   payload.first_token,
+                   payload.sample_key[0] & 0xFFFFFFFF,
+                   payload.sample_key[1] & 0xFFFFFFFF),
+        bytes([len(dtype_b)]), dtype_b,
+        bytes([len(model_b)]), model_b,
+    ]
+    for name in names:
+        arr = np.ascontiguousarray(payload.leaves[name])
+        want = leaf_shape(payload, name)
+        if tuple(arr.shape) != want:
+            raise KVWireError(
+                f"leaf {name!r} has shape {tuple(arr.shape)}, "
+                f"expected {want}")
+        buf = arr.tobytes()
+        parts.append(_SIZE.pack(len(buf)))
+        parts.append(buf)
+    return b"".join(parts)
+
+
+def unpack(data: bytes) -> KVPayload:
+    """Parse + validate one payload. Strict: any structural defect —
+    short header, bad magic, version/codec mismatch, leaf size that
+    disagrees with the declared geometry, or trailing garbage — raises
+    :class:`KVWireError` before a single leaf is admitted."""
+    data = bytes(data)
+    if len(data) < _HEAD.size:
+        raise KVWireError(
+            f"truncated KV payload: {len(data)} bytes < "
+            f"{_HEAD.size}-byte header")
+    (magic, version, codec, _flags, page, tokens, n_layers, n_kv_heads,
+     head_dim, n_pages, first_token, key0, key1) = _HEAD.unpack_from(data)
+    if magic != MAGIC:
+        raise KVWireError(f"bad KV payload magic {magic!r}")
+    if version != VERSION:
+        raise KVWireError(
+            f"unsupported KV wire version {version} (speak {VERSION})")
+    names = leaf_names(codec)   # raises on unknown codec
+    off = _HEAD.size
+    dtype, off = _read_str(data, off, "dtype")
+    model, off = _read_str(data, off, "model")
+    if page <= 0 or tokens <= 0 or n_pages <= 0:
+        raise KVWireError(
+            f"degenerate geometry: page={page} tokens={tokens} "
+            f"n_pages={n_pages}")
+    if n_pages != -(-tokens // page):
+        raise KVWireError(
+            f"geometry mismatch: {tokens} tokens need "
+            f"{-(-tokens // page)} pages of {page}, header says {n_pages}")
+    payload = KVPayload(codec, dtype, page, tokens, n_layers, n_kv_heads,
+                        head_dim, n_pages, first_token, (key0, key1),
+                        model, {})
+    for name in names:
+        if off + _SIZE.size > len(data):
+            raise KVWireError(f"truncated KV payload at leaf {name!r}")
+        (nbytes,) = _SIZE.unpack_from(data, off)
+        off += _SIZE.size
+        shape = leaf_shape(payload, name)
+        dt = _leaf_dtype(payload, name)
+        want = int(np.prod(shape)) * dt.itemsize
+        if nbytes != want:
+            raise KVWireError(
+                f"leaf {name!r} declares {nbytes} bytes, geometry "
+                f"needs {want}")
+        if off + nbytes > len(data):
+            raise KVWireError(
+                f"truncated KV payload: leaf {name!r} short by "
+                f"{off + nbytes - len(data)} bytes")
+        payload.leaves[name] = np.frombuffer(
+            data, dtype=dt, count=want // dt.itemsize,
+            offset=off).reshape(shape)
+        off += nbytes
+    if off != len(data):
+        raise KVWireError(
+            f"{len(data) - off} trailing bytes after the last leaf")
+    return payload
+
+
+def _read_str(data: bytes, off: int, what: str) -> Tuple[str, int]:
+    if off >= len(data):
+        raise KVWireError(f"truncated KV payload at {what} length")
+    n = data[off]
+    off += 1
+    if off + n > len(data):
+        raise KVWireError(f"truncated KV payload at {what} bytes")
+    return data[off:off + n].decode("utf-8", errors="replace"), off + n
+
+
+def iter_chunks(data: bytes,
+                chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> Iterator[bytes]:
+    """Split a packed payload into bounded transfer frames (the gRPC
+    stream / chunked-HTTP unit). Order-preserving; ``assemble`` is the
+    inverse."""
+    if chunk_bytes <= 0:
+        raise ValueError("chunk_bytes must be positive")
+    for start in range(0, len(data), chunk_bytes):
+        yield data[start:start + chunk_bytes]
+    if not data:
+        yield b""
+
+
+def assemble(chunks: Iterable[bytes]) -> bytes:
+    return b"".join(bytes(c) for c in chunks)

@@ -69,14 +69,20 @@ class ModelUnavailable(RuntimeError):
 
 
 class _Entry:
-    __slots__ = ("name", "engine", "state", "fallback", "loaded_at")
+    __slots__ = ("name", "engine", "state", "fallback", "loaded_at",
+                 "role")
 
-    def __init__(self, name: str, engine: Any, fallback: Optional[str]):
+    def __init__(self, name: str, engine: Any, fallback: Optional[str],
+                 role: str = "both"):
         self.name = name
         self.engine = engine
         self.state = STATE_LOADING
         self.fallback = fallback
         self.loaded_at = time.monotonic()
+        # disaggregated serving (ISSUE 8): which phase this entry serves
+        # ("prefill" | "decode" | "both") — observability keying only;
+        # routing between roles is tpu/cluster.py's job
+        self.role = role
 
 
 class ModelRegistry:
@@ -96,24 +102,31 @@ class ModelRegistry:
     # -- lifecycle ----------------------------------------------------------
     def register(self, name: str, engine: Any,
                  fallback: Optional[str] = None,
-                 default: bool = False) -> _Entry:
+                 default: bool = False, role: str = "both") -> _Entry:
         """Add a named engine in LOADING state. The first registration
         (or ``default=True``) becomes the unnamed-route default.
         ``fallback`` names the model DEGRADED/unavailable traffic shifts
-        to — it may be registered later; resolution happens per-route."""
+        to — it may be registered later; resolution happens per-route.
+        ``role`` tags the entry's serving phase for the disaggregated
+        topology (prefill/decode/both) so /debug pages key per role."""
         name = str(name)
         if name in self._entries:
             raise ValueError(f"model {name!r} is already registered")
         if fallback == name:
             raise ValueError(f"model {name!r} cannot fall back to itself")
-        entry = _Entry(name, engine, fallback)
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"model {name!r} role {role!r}: expected prefill, "
+                "decode, or both")
+        entry = _Entry(name, engine, fallback, role)
         self._entries[name] = entry
         if default or self._default is None:
             self._default = name
         self._set_state(entry, STATE_LOADING)
         if self.logger is not None:
-            self.logger.info("registry: registered model %r (fallback=%r)",
-                             name, fallback)
+            self.logger.info(
+                "registry: registered model %r (fallback=%r, role=%s)",
+                name, fallback, role)
         return entry
 
     async def warmup(self, name: str, **kwargs) -> None:
@@ -242,6 +255,7 @@ class ModelRegistry:
             "models": {
                 name: {
                     "state": entry.state,
+                    "role": entry.role,
                     "fallback": entry.fallback,
                     "stats": entry.engine.stats(),
                 }
@@ -263,7 +277,8 @@ class ModelRegistry:
             "default": self._default,
             "models": {
                 name: dict(entry.engine.statusz(recent=recent),
-                           state=entry.state, fallback=entry.fallback)
+                           state=entry.state, role=entry.role,
+                           fallback=entry.fallback)
                 for name, entry in self._entries.items()
                 if entry.state != STATE_UNLOADED
             },
@@ -296,6 +311,7 @@ class ModelRegistry:
             health = entry.engine.health_check()
             details["models"][name] = {
                 "state": entry.state,
+                "role": entry.role,
                 "engine": health["status"],
             }
             # an UNLOADED/LOADING model is not a failure; a READY model

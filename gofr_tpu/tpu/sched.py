@@ -36,6 +36,11 @@ from typing import Any, Deque, Dict, Iterable, Optional, Tuple
 CLASS_INTERACTIVE = "interactive"
 CLASS_STANDARD = "standard"
 CLASS_BATCH = "batch"
+# Disaggregated serving (ISSUE 8): requests whose prefill already ran on
+# another replica. Their KV pages are on the wire or already resident, so
+# stalling them wastes work two replicas performed — decode replicas give
+# them the highest default weight.
+CLASS_MIGRATED = "migrated"
 
 SLO_CLASSES = (CLASS_INTERACTIVE, CLASS_STANDARD, CLASS_BATCH)
 
@@ -44,6 +49,41 @@ DEFAULT_CLASS_WEIGHTS: Dict[str, float] = {
     CLASS_STANDARD: 2.0,
     CLASS_BATCH: 1.0,
 }
+
+# Per-role admission presets (tpu/cluster.py roles). A prefill replica's
+# product is TTFT, so interactive traffic dominates harder than the
+# shared default; a decode replica must land migrated KV before anything
+# else (see CLASS_MIGRATED); ``both`` is the monolithic default.
+ROLE_CLASS_WEIGHTS: Dict[str, Dict[str, float]] = {
+    "prefill": {CLASS_INTERACTIVE: 8.0, CLASS_STANDARD: 2.0,
+                CLASS_BATCH: 1.0},
+    "decode": {CLASS_MIGRATED: 8.0, CLASS_INTERACTIVE: 4.0,
+               CLASS_STANDARD: 2.0, CLASS_BATCH: 1.0},
+    "both": dict(DEFAULT_CLASS_WEIGHTS),
+}
+
+
+def role_class_weights(role: str,
+                       spec: Optional[str] = None) -> Dict[str, float]:
+    """Admission weights for a replica role, with an optional
+    ``SLO_CLASS_WEIGHTS``-style override spec layered on top (explicit
+    operator knobs beat role presets)."""
+    weights = dict(ROLE_CLASS_WEIGHTS.get(role, DEFAULT_CLASS_WEIGHTS))
+    # only classes the spec names are layered on top — running the spec
+    # through parse_class_weights would re-apply the shared defaults and
+    # silently undo the role preset for every unmentioned class
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        name, _, raw = part.partition(":")
+        try:
+            weight = float(raw)
+        except ValueError:
+            continue
+        if weight > 0:
+            weights[name.strip()] = weight
+    return weights
 
 # Deadline budget at or below this is "a human is waiting" traffic.
 DEFAULT_INTERACTIVE_BUDGET_S = 2.0
@@ -158,7 +198,8 @@ class ClassQueues:
 
 
 __all__ = [
-    "CLASS_INTERACTIVE", "CLASS_STANDARD", "CLASS_BATCH", "SLO_CLASSES",
-    "DEFAULT_CLASS_WEIGHTS", "DEFAULT_INTERACTIVE_BUDGET_S",
-    "deadline_class", "parse_class_weights", "ClassQueues",
+    "CLASS_INTERACTIVE", "CLASS_STANDARD", "CLASS_BATCH", "CLASS_MIGRATED",
+    "SLO_CLASSES", "DEFAULT_CLASS_WEIGHTS", "ROLE_CLASS_WEIGHTS",
+    "DEFAULT_INTERACTIVE_BUDGET_S", "deadline_class", "parse_class_weights",
+    "role_class_weights", "ClassQueues",
 ]

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Tier-1 disagg smoke: a 2-role cluster in ONE process, in-proc
+transport, tiny model on forced host devices.
+
+Drives the exact tentpole path end-to-end — prefill replica exports KV,
+the payload round-trips the kv_wire codec, the decode replica adopts it
+as page-table entries, the router relays the stream — and asserts the
+two acceptance properties cheap enough to gate every commit on:
+
+1. greedy tokens identical to monolithic serving,
+2. ZERO prefill dispatches on the decode replica, and drain returns the
+   decode pool's free list to its idle level.
+
+Prints ``disagg smoke: OK`` and exits 0, or raises with the failing
+property. Budget: a few seconds on 8 host CPU devices.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.cluster import (ROLE_DECODE, ClusterRegistry,
+                                      DisaggRouter, InProcTransport,
+                                      NoReplicaAvailable)
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+
+    def build(paged):
+        container = new_mock_container()
+        kwargs = dict(paged_kv=True) if paged else {}
+        return GenerationEngine(cfg, params, max_slots=2, max_len=32,
+                                prompt_buckets=(8,), kv_page=4,
+                                logger=container.logger,
+                                metrics=container.metrics, **kwargs)
+
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    budget = 6
+
+    async def monolithic():
+        engine = build(True)
+        await engine.start()
+        try:
+            return [await asyncio.wait_for(
+                engine.generate(p, max_new_tokens=budget), 60.0)
+                for p in prompts]
+        finally:
+            await engine.stop()
+
+    async def disagg():
+        prefill_eng, decode_eng = build(False), build(True)
+        cluster = ClusterRegistry()
+        cluster.register("p0", "prefill", InProcTransport(prefill_eng))
+        cluster.register("d0", "decode", InProcTransport(decode_eng))
+        router = DisaggRouter(cluster)
+        await decode_eng.start()
+        try:
+            idle_pages = decode_eng._pool.free_pages
+            outs = [await asyncio.wait_for(
+                router.generate(p, max_new_tokens=budget), 60.0)
+                for p in prompts]
+            stats = decode_eng.stats()
+            assert stats["prefill_bucket_tokens"] == 0, \
+                f"decode replica ran prefill: {stats['prefill_bucket_tokens']}"
+            assert stats["kv_adoptions"] == len(prompts)
+            # drain: routing stops, pages come back to the idle level
+            assert await cluster.drain("d0", timeout_s=30.0)
+            try:
+                cluster.pick(ROLE_DECODE)
+            except NoReplicaAvailable:
+                pass
+            else:
+                raise AssertionError("DRAINING replica still routable")
+            for _ in range(200):
+                if decode_eng._pool.free_pages == idle_pages:
+                    break
+                await asyncio.sleep(0.02)
+            assert decode_eng._pool.free_pages == idle_pages, \
+                (decode_eng._pool.free_pages, idle_pages)
+            return outs
+        finally:
+            await decode_eng.stop()
+
+    ref = asyncio.run(monolithic())
+    outs = asyncio.run(disagg())
+    assert outs == ref, f"token identity broke: {outs} != {ref}"
+    print("disagg smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""GT006 negative fixture: KV transfer work staged off the event loop.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import asyncio
+
+import numpy as np
+
+from gofr_tpu.tpu import kv_wire
+
+
+def _export(pool):
+    # only ever *passed* to an executor: no call edge from the loop, so
+    # the device->host copy and the serialization are both exempt
+    host = {name: np.asarray(pool.leaves[name]) for name in pool.leaves}
+    return host
+
+
+async def export_handler(pool):
+    loop = asyncio.get_running_loop()
+    host = await loop.run_in_executor(None, _export, pool)
+    blob = await loop.run_in_executor(None, kv_wire.pack, host)
+    return blob
+
+
+async def adopt_handler(blob):
+    payload = await asyncio.to_thread(kv_wire.unpack, blob)
+    return payload
+
+
+async def metadata_only(pool):
+    # touching pool bookkeeping (not leaves) stays legal on the loop
+    return {"free": len(pool.free_pages), "page": pool.page}

@@ -1,0 +1,37 @@
+"""GT006 positive fixture: KV pool leaves materialized on the loop.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import jax
+import numpy as np
+
+from gofr_tpu.tpu import kv_wire
+
+
+async def export_handler(pool):
+    # sync device->host copy of a whole prompt's KV pages on the loop
+    return np.asarray(pool.leaves["k"])
+
+
+def _stage(engine):
+    return jax.device_get(engine._pool.leaves["v"])
+
+
+async def transitive(engine):
+    # blocks through a plain-call hop: transitive -> _stage -> device sync
+    return _stage(engine)
+
+
+async def pack_inline(payload):
+    # kv_wire.pack walks every leaf buffer on the calling thread
+    return kv_wire.pack(payload)
+
+
+async def adopt_inline(blob):
+    return kv_wire.unpack(blob)
+
+
+async def serialize(pool):
+    # the serialization copy itself, without np.asarray
+    return pool.leaves["k"].tobytes()

@@ -1,0 +1,430 @@
+"""Disaggregated serving (ISSUE 8): kv_wire codec, cluster routing, and
+the prefill→decode handoff.
+
+The load-bearing contracts, in order:
+
+1. WIRE FIDELITY — pack → iter_chunks → assemble → unpack is byte-exact
+   for both codecs (bf16 raw, int8 + scale planes), and any structural
+   defect (truncation, bad magic, version skew, geometry lies, trailing
+   garbage) raises ``KVWireError`` before a single leaf is admitted.
+2. TOKEN IDENTITY, ZERO RE-PREFILL — greedy decode through the disagg
+   router (prefill replica exports, decode replica adopts) emits exactly
+   the monolithic engine's stream, while the decode replica's
+   ``prefill_bucket_tokens`` stays at zero: migrated KV becomes
+   page-table entries, never a prefill dispatch.
+3. DRAIN IS LOSSLESS — a DRAINING decode replica takes no new routes,
+   finishes its in-flight streams, and its page-pool free list returns
+   to the pre-test level (migrated pages ride the normal slot teardown).
+"""
+
+import asyncio
+import dataclasses
+import struct
+import time
+
+import jax
+import ml_dtypes
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.tpu import kv_wire
+from gofr_tpu.tpu.cluster import (ROLE_DECODE, ROLE_PREFILL, ClusterRegistry,
+                                  DisaggRouter, HandoffTable,
+                                  InProcTransport, NoReplicaAvailable,
+                                  parse_peers)
+from gofr_tpu.tpu.generate import GenerationEngine, Sampling
+from gofr_tpu.tpu.kv_wire import (CODEC_INT8, CODEC_RAW, KVPayload,
+                                  KVWireError)
+from gofr_tpu.trace.tracer import ListExporter, Tracer
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _make_engine(cfg, params, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    engine = GenerationEngine(cfg, params, logger=container.logger,
+                              metrics=container.metrics, **kwargs)
+    return engine, container
+
+
+# -- kv_wire: synthetic payloads ---------------------------------------------
+
+def _payload(codec, tokens=6, page=4, n_layers=2, n_kv_heads=2, head_dim=4,
+             dtype="bfloat16"):
+    n_pages = -(-tokens // page)
+    payload = KVPayload(codec=codec, dtype=dtype, page=page, tokens=tokens,
+                        n_layers=n_layers, n_kv_heads=n_kv_heads,
+                        head_dim=head_dim, n_pages=n_pages, first_token=17,
+                        sample_key=(0xDEAD, 0xBEEF), model="tiny",
+                        leaves={})
+    rng = np.random.default_rng(7)
+    for name in kv_wire.leaf_names(codec):
+        shape = kv_wire.leaf_shape(payload, name)
+        if codec == CODEC_INT8 and name in ("k", "v"):
+            payload.leaves[name] = rng.integers(
+                -128, 128, size=shape, dtype=np.int8)
+        elif codec == CODEC_INT8:      # ks/vs scale planes
+            payload.leaves[name] = rng.random(shape, dtype=np.float32)
+        else:
+            payload.leaves[name] = rng.standard_normal(shape).astype(
+                ml_dtypes.bfloat16)
+    return payload
+
+
+@pytest.mark.parametrize("codec", [CODEC_RAW, CODEC_INT8])
+def test_wire_roundtrip_is_byte_exact(codec):
+    src = _payload(codec)
+    blob = kv_wire.pack(src)
+    chunks = list(kv_wire.iter_chunks(blob, chunk_bytes=64))
+    assert all(len(c) <= 64 for c in chunks)
+    assert sum(len(c) for c in chunks) == len(blob)
+    out = kv_wire.unpack(kv_wire.assemble(chunks))
+    assert (out.codec, out.dtype, out.page, out.tokens) == \
+        (src.codec, src.dtype, src.page, src.tokens)
+    assert (out.n_layers, out.n_kv_heads, out.head_dim, out.n_pages) == \
+        (src.n_layers, src.n_kv_heads, src.head_dim, src.n_pages)
+    assert out.first_token == 17 and out.sample_key == (0xDEAD, 0xBEEF)
+    assert out.model == "tiny"
+    assert sorted(out.leaves) == sorted(src.leaves)
+    for name, arr in src.leaves.items():
+        assert out.leaves[name].tobytes() == arr.tobytes()
+        assert out.leaves[name].shape == arr.shape
+
+
+def test_wire_rejects_corruption():
+    blob = kv_wire.pack(_payload(CODEC_RAW))
+
+    with pytest.raises(KVWireError, match="truncated"):
+        kv_wire.unpack(blob[:10])                    # short header
+    with pytest.raises(KVWireError, match="magic"):
+        kv_wire.unpack(b"XKVW" + blob[4:])           # bad magic
+    with pytest.raises(KVWireError, match="version"):
+        kv_wire.unpack(blob[:4] + struct.pack("<H", 99) + blob[6:])
+    with pytest.raises(KVWireError, match="trailing"):
+        kv_wire.unpack(blob + b"\x00")               # trailing garbage
+    with pytest.raises(KVWireError, match="truncated"):
+        kv_wire.unpack(blob[:-5])                    # short last leaf
+    # lie about n_pages in the header: tokens=6/page=4 needs 2 pages
+    head = list(kv_wire._HEAD.unpack_from(blob))
+    head[9] += 1
+    with pytest.raises(KVWireError, match="geometry"):
+        kv_wire.unpack(kv_wire._HEAD.pack(*head) + blob[kv_wire._HEAD.size:])
+
+
+def test_wire_pack_validates_leaves():
+    src = _payload(CODEC_INT8)
+    del src.leaves["vs"]
+    with pytest.raises(KVWireError, match="lacks leaves"):
+        kv_wire.pack(src)
+    bad = _payload(CODEC_RAW)
+    bad.leaves["v"] = bad.leaves["v"][:, :1]         # wrong page count
+    with pytest.raises(KVWireError, match="shape"):
+        kv_wire.pack(bad)
+
+
+def test_resolve_codec_refuses_transcoding(setup):
+    cfg, _ = setup
+    cfg8 = dataclasses.replace(cfg, kv_int8=True)
+    assert kv_wire.resolve_codec("auto", cfg) == CODEC_RAW
+    assert kv_wire.resolve_codec("auto", cfg8) == CODEC_INT8
+    assert kv_wire.resolve_codec("bf16", cfg) == CODEC_RAW
+    assert kv_wire.resolve_codec("int8", cfg8) == CODEC_INT8
+    with pytest.raises(ValueError, match="storage format"):
+        kv_wire.resolve_codec("int8", cfg)           # pool is bf16
+    with pytest.raises(ValueError, match="storage format"):
+        kv_wire.resolve_codec("bf16", cfg8)          # pool is int8
+
+
+# -- cluster plumbing: peers, handoffs, registry ------------------------------
+
+def test_parse_peers():
+    peers = parse_peers(
+        "p0=prefill@http://10.0.0.1:8000#10.0.0.1:9000, "
+        "d0=decode@http://10.0.0.2:8000")
+    assert peers == [
+        ("p0", "prefill", "http://10.0.0.1:8000", "10.0.0.1:9000"),
+        ("d0", "decode", "http://10.0.0.2:8000", None),
+    ]
+    assert parse_peers(None) == [] and parse_peers("") == []
+    with pytest.raises(ValueError, match="name=role@url"):
+        parse_peers("p0@http://x")                   # missing role
+    with pytest.raises(ValueError, match="role"):
+        parse_peers("p0=router@http://x")            # unknown role
+
+
+def test_handoff_table_capacity_and_ttl():
+    table = HandoffTable(capacity=2, ttl_s=60.0)
+    first = table.put(b"one")
+    second = table.put(b"two")
+    third = table.put(b"three")                      # evicts the oldest
+    assert len(table) == 2
+    assert table.get(third) == b"three" and table.get(second) == b"two"
+    with pytest.raises(KeyError):
+        table.get(first)
+    table.pop(third)
+    assert len(table) == 1
+
+    brief = HandoffTable(capacity=4, ttl_s=0.02)
+    handoff = brief.put(b"blob")
+    time.sleep(0.05)
+    with pytest.raises(KeyError, match="expired"):
+        brief.get(handoff)
+
+
+class _FakeTransport:
+    kind = "fake"
+
+    def __init__(self, up=True, circuit_open=False):
+        self.up = up
+        self.circuit_open = circuit_open
+
+    def available(self):
+        return not self.circuit_open
+
+    def health_check(self):
+        return {"status": "UP" if self.up else "DOWN"}
+
+    def describe(self):
+        return {"kind": self.kind}
+
+
+def test_registry_routes_by_role_round_robin():
+    cluster = ClusterRegistry()
+    cluster.register("p0", "prefill", _FakeTransport())
+    cluster.register("d0", "decode", _FakeTransport())
+    cluster.register("d1", "decode", _FakeTransport())
+    with pytest.raises(ValueError, match="role"):
+        cluster.register("x", "router", _FakeTransport())
+    with pytest.raises(ValueError, match="already registered"):
+        cluster.register("p0", "prefill", _FakeTransport())
+
+    assert cluster.pick(ROLE_PREFILL).name == "p0"
+    picked = [cluster.pick(ROLE_DECODE).name for _ in range(4)]
+    assert sorted(set(picked)) == ["d0", "d1"]       # round-robin over both
+    assert cluster.roles() == {"prefill": ["p0"], "decode": ["d0", "d1"]}
+
+    # a ``both`` replica serves either phase
+    solo = ClusterRegistry()
+    solo.register("m0", "both", _FakeTransport())
+    assert solo.pick(ROLE_PREFILL).name == "m0"
+    assert solo.pick(ROLE_DECODE).name == "m0"
+
+
+def test_registry_skips_open_circuits_and_draining():
+    cluster = ClusterRegistry()
+    cluster.register("d0", "decode", _FakeTransport(circuit_open=True))
+    with pytest.raises(NoReplicaAvailable) as err:
+        cluster.pick(ROLE_DECODE)
+    assert err.value.status_code == 503
+
+    cluster.register("d1", "decode", _FakeTransport())
+    assert cluster.pick(ROLE_DECODE).name == "d1"
+    assert asyncio.run(cluster.drain("d1")) is True  # idle: drains at once
+    with pytest.raises(NoReplicaAvailable):
+        cluster.pick(ROLE_DECODE)
+    cluster.resume("d1")
+    assert cluster.pick(ROLE_DECODE).name == "d1"
+
+
+def test_cluster_health_is_role_aware():
+    cluster = ClusterRegistry()
+    cluster.register("p0", "prefill", _FakeTransport())
+    assert cluster.health_check()["status"] == "DOWN"   # no decode capacity
+    cluster.register("d0", "decode", _FakeTransport())
+    health = cluster.health_check()
+    assert health["status"] == "UP"
+    assert health["details"]["roles"] == {"prefill": ["p0"],
+                                          "decode": ["d0"]}
+    asyncio.run(cluster.drain("d0"))
+    assert cluster.health_check()["status"] == "DOWN"   # decode tier gone
+
+
+# -- tentpole: disagg token identity ------------------------------------------
+
+async def _monolithic(cfg, params, requests, prefix_cache=False):
+    engine, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                             prefix_cache=prefix_cache)
+    await engine.start()
+    try:
+        outs = []
+        for prompt, budget, sampling in requests:
+            outs.append(await asyncio.wait_for(engine.generate(
+                prompt, max_new_tokens=budget, sampling=sampling), 60.0))
+        return outs
+    finally:
+        await engine.stop()
+
+
+async def _disagg(cfg, params, requests, tracer=None, prefix_cache=False):
+    """1 prefill + 1 decode replica behind the router; the prefill
+    replica runs DENSE (export reads the small cache, never a pool) —
+    the decode replica is the only paged engine in the topology."""
+    prefill_eng, _ = _make_engine(cfg, params, kv_page=4, tracer=tracer)
+    decode_eng, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                                 tracer=tracer, prefix_cache=prefix_cache)
+    cluster = ClusterRegistry()
+    cluster.register("p0", ROLE_PREFILL, InProcTransport(prefill_eng))
+    cluster.register("d0", ROLE_DECODE, InProcTransport(decode_eng))
+    router = DisaggRouter(cluster, tracer=tracer)
+    await decode_eng.start()                 # prefill needs no engine loop
+    try:
+        outs = []
+        for prompt, budget, sampling in requests:
+            outs.append(await asyncio.wait_for(router.generate(
+                prompt, max_new_tokens=budget, sampling=sampling), 60.0))
+        return outs, prefill_eng, decode_eng, router
+    finally:
+        await decode_eng.stop()
+
+
+@pytest.mark.parametrize("kv_int8,prefix_cache", [
+    (False, False),     # bf16 wire, prefix cache off
+    (True, False),      # int8 + scale planes on the wire
+    (False, True),      # monolithic ref serves its repeats via the
+                        # prefix cache; disagg must still match it
+])
+def test_disagg_greedy_token_identity(setup, kv_int8, prefix_cache):
+    """The acceptance criterion: identical greedy streams through the
+    split topology, with ZERO prefill dispatches on the decode replica —
+    for both wire codecs (bf16 raw, int8 + scales), with the prefix
+    cache on and off."""
+    cfg, params = setup
+    if kv_int8:
+        cfg = dataclasses.replace(cfg, kv_int8=True)
+    requests = [([1, 2, 3, 4, 5], 8, None),
+                (list(range(1, 11)), 8, None),       # 16-bucket, 3 pages
+                ([9, 8, 7], 6, None),
+                ([1, 2, 3, 4, 5], 8, None)]          # slot churn / cache hit
+
+    ref = asyncio.run(_monolithic(cfg, params, requests,
+                                  prefix_cache=prefix_cache))
+    outs, prefill_eng, decode_eng, router = asyncio.run(
+        _disagg(cfg, params, requests, prefix_cache=prefix_cache))
+    assert outs == ref
+    assert all(len(out) == budget for out, (_, budget, _)
+               in zip(outs, requests))
+
+    decode_stats = decode_eng.stats()
+    assert decode_stats["prefill_bucket_tokens"] == 0   # zero re-prefill
+    assert decode_stats["kv_adoptions"] == len(requests)
+    prefill_stats = prefill_eng.stats()
+    assert prefill_stats["kv_exports"] == len(requests)
+    assert prefill_stats["prefill_bucket_tokens"] > 0
+    assert router.stats()["requests"] == len(requests)
+    assert router.stats()["bytes_shipped"] > 0
+
+    # the wire cost lands on the decode replica's flight records
+    recent = decode_eng.recorder.snapshot()["recent"]
+    assert len(recent) == len(requests)
+    assert all(row["kv_transfer_bytes"] > 0 for row in recent)
+
+
+def test_disagg_sampled_identity_with_explicit_seed(setup):
+    """The exported payload carries the advanced PRNG key, so *sampled*
+    decode continues bitwise-identically too. Seeds must be explicit:
+    ``Sampling(seed=None)`` draws fresh entropy per construction."""
+    cfg, params = setup
+    sampled = lambda: Sampling(temperature=0.9, top_k=7, seed=1234)
+    requests = [([1, 2, 3, 4, 5], 8, sampled()),
+                (list(range(1, 9)), 8, sampled())]
+
+    ref = asyncio.run(_monolithic(cfg, params, requests))
+    outs, _, decode_eng, _ = asyncio.run(_disagg(cfg, params, requests))
+    assert outs == ref
+    assert decode_eng.stats()["prefill_bucket_tokens"] == 0
+
+
+def test_disagg_trace_stitches_across_the_hop(setup):
+    """One trace spans the split: the router's ``kv_transfer`` span
+    (bytes + both replica names) parents the prefill replica's
+    ``prefill.export`` and the decode replica's ``kv_adopt`` via the
+    forwarded traceparent."""
+    cfg, params = setup
+    exporter = ListExporter()
+    tracer = Tracer(exporter=exporter)
+    outs, _, _, _ = asyncio.run(_disagg(
+        cfg, params, [([1, 2, 3], 4, None)], tracer=tracer))
+    tracer.shutdown()
+    assert len(outs[0]) == 4
+
+    (transfer,) = exporter.find("kv_transfer")
+    assert int(transfer.attributes["bytes"]) > 0
+    assert transfer.attributes["prefill_replica"] == "p0"
+    assert transfer.attributes["decode_replica"] == "d0"
+    assert transfer.attributes["transport"] == "inproc"
+    (adopt,) = exporter.find("kv_adopt")
+    assert adopt.trace_id == transfer.trace_id       # joined via traceparent
+    assert int(adopt.attributes["transfer_bytes"]) > 0
+
+
+def test_adopt_rejects_geometry_and_codec_mismatch(setup):
+    cfg, params = setup
+    engine, _ = _make_engine(cfg, params, paged_kv=True, kv_page=8)
+
+    async def run():
+        source, _ = _make_engine(cfg, params, kv_page=4)
+        payload = await source.prefill_export([1, 2, 3, 4, 5])
+        with pytest.raises(KVWireError, match="page size"):
+            await engine.adopt_kv(payload, 4)        # page 4 into kv_page 8
+        wrong = _payload(CODEC_INT8, page=8, n_layers=cfg.n_layers,
+                         n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim)
+        with pytest.raises(KVWireError, match="codec"):
+            await engine.adopt_kv(wrong, 4)          # int8 into a bf16 pool
+        alien = _payload(CODEC_RAW, page=8)          # 2-layer toy geometry
+        with pytest.raises(KVWireError, match="geometry"):
+            await engine.adopt_kv(alien, 4)
+
+    asyncio.run(run())
+
+
+# -- acceptance: drain is lossless --------------------------------------------
+
+def test_decode_drain_finishes_streams_and_releases_pages(setup):
+    """DRAINING stops routing immediately, in-flight streams run to
+    completion, and the decode pool's free list returns to its pre-test
+    level — migrated pages release through normal slot teardown."""
+    cfg, params = setup
+
+    async def run():
+        prefill_eng, _ = _make_engine(cfg, params, kv_page=4)
+        decode_eng, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4)
+        cluster = ClusterRegistry()
+        cluster.register("p0", ROLE_PREFILL, InProcTransport(prefill_eng))
+        cluster.register("d0", ROLE_DECODE, InProcTransport(decode_eng))
+        router = DisaggRouter(cluster)
+        await decode_eng.start()
+        try:
+            baseline = decode_eng._pool.free_pages
+            stream = await router.generate_stream([1, 2, 3, 4, 5],
+                                                  max_new_tokens=6)
+            tokens = [await asyncio.wait_for(stream.__anext__(), 60.0)]
+            drain_task = asyncio.create_task(
+                cluster.drain("d0", timeout_s=30.0))
+            await asyncio.sleep(0)                   # DRAINING is immediate
+            with pytest.raises(NoReplicaAvailable):
+                cluster.pick(ROLE_DECODE)
+            async for token in stream:               # in-flight finishes
+                tokens.append(token)
+            assert len(tokens) == 6
+            assert await asyncio.wait_for(drain_task, 30.0) is True
+            for _ in range(200):                     # slot teardown lands
+                if decode_eng._pool.free_pages == baseline:
+                    break
+                await asyncio.sleep(0.02)
+            assert decode_eng._pool.free_pages == baseline
+            cluster.resume("d0")
+            assert cluster.pick(ROLE_DECODE).name == "d0"
+        finally:
+            await decode_eng.stop()
+
+    asyncio.run(run())

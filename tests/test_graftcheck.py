@@ -153,6 +153,24 @@ def test_gt005_negative_documented_and_registered_is_clean():
     assert report.new_findings == []
 
 
+# -- GT006 kv-transfer-sync --------------------------------------------------
+
+def test_gt006_positive_flags_loop_side_kv_materialization():
+    report = scan("gt006_pos.py", "GT006")
+    got = keys(report)
+    assert "numpy.asarray(...) on pool leaves in export_handler" in got
+    # transitive: async transitive() -> _stage() -> jax.device_get
+    assert "jax.device_get(...) on pool leaves in _stage" in got
+    assert "kv_wire.pack(...) in pack_inline" in got
+    assert "kv_wire.unpack(...) in adopt_inline" in got
+    assert ".tobytes() on pool leaves in serialize" in got
+
+
+def test_gt006_negative_executor_staged_transfer_is_clean():
+    report = scan("gt006_neg.py", "GT006")
+    assert report.new_findings == []
+
+
 # -- engine mechanics --------------------------------------------------------
 
 def _write_module(tmp_path, body):
@@ -277,7 +295,7 @@ def test_cli_list_rules_covers_catalog():
     for cls in ALL_RULES:
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
-        {"GT001", "GT002", "GT003", "GT004", "GT005"}
+        {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006"}
 
 
 def test_lint_metrics_shim_still_works():

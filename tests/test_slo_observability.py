@@ -202,10 +202,13 @@ class TestWatchdog:
         assert states == ["DEGRADED", "DEGRADED", "READY", "READY", "READY"]
         assert dog.transitions == 2
         metrics = container.metrics
+        # transitions are keyed by replica role (disaggregated fleets
+        # tell a sick prefill tier from a sick decode tier); a bare
+        # watchdog is role "both"
         assert metrics.value("app_health_transitions_total",
-                             to="DEGRADED") == 1.0
+                             to="DEGRADED", role="both") == 1.0
         assert metrics.value("app_health_transitions_total",
-                             to="READY") == 1.0
+                             to="READY", role="both") == 1.0
 
     def test_streak_resets_prevent_flapping(self):
         slo = SLOTracker()
