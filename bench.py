@@ -142,6 +142,21 @@ REGRESSION_NOTES = {
         "new in r9: mean packed-KV bytes shipped per migrated request — "
         "moves with prompt-length mix and codec (bf16 vs int8+scales), "
         "so pin the workload before reading a delta"),
+    "resnet50_full_path_vs_device_only": (
+        "new in r10 (zero-copy data plane): relay-included classify "
+        "rate / device-only rate — the fraction of the hardware the "
+        "full served path delivers (r5-r9 hovered ~0.54). Staging slabs "
+        "+ input donation attack the numerator's host-copy share; relay "
+        "health also moves it, so read alongside the same-run `relay` "
+        "block"),
+    "llama7b_full_path_vs_device_only": (
+        "new in r10: 7B engine aggregate tok/s / device-only tok/s — "
+        "the host-dispatch share of the decode loop; coalesced tick "
+        "uploads and slab staging are the levers"),
+    "h2d_staged_roundtrip_ms": (
+        "micro-scenario through the relay: the absolute number swings "
+        "with relay health — judge staged vs unstaged and coalesced vs "
+        "per-array within the SAME run, not across rounds"),
 }
 
 _LEDGER_PATHS = {
@@ -167,6 +182,12 @@ _LEDGER_PATHS = {
     "llama_disagg_decode_tok_s": ("llama_disagg", "decode_tok_s_disagg"),
     "llama_disagg_transfer_bytes_per_req": ("llama_disagg",
                                             "transfer_bytes_per_req"),
+    "resnet50_full_path_vs_device_only": ("full_path_vs_device_only",
+                                          "resnet50"),
+    "llama7b_full_path_vs_device_only": ("full_path_vs_device_only",
+                                         "llama7b"),
+    "h2d_staged_roundtrip_ms": ("h2d_roundtrip",
+                                "dispatch_roundtrip_ms_staged"),
 }
 
 
@@ -229,6 +250,7 @@ def main() -> None:
     on_tpu = platform != "cpu"
 
     relay = _relay_floor_bench()
+    h2d = _h2d_roundtrip_bench()
     resnet_stats = _resnet_bench(on_tpu)
     http_stats = _http_bench(on_tpu)
     bert_stats = _bert_grpc_bench(on_tpu)
@@ -248,6 +270,7 @@ def main() -> None:
         "vs_baseline": round(req_per_s / TARGET_REQ_S, 3),
         "platform": platform,
         "relay": relay,
+        "h2d_roundtrip": h2d,
         **resnet_stats,
         **http_stats,
         "bert": bert_stats,
@@ -260,6 +283,17 @@ def main() -> None:
         "multi_model": multi_model,
         "llama7b_int8": llama7b,
     }
+    # how much of the hardware the full served path delivers — THE ratio
+    # the zero-copy data plane exists to move (ISSUE 9 acceptance)
+    ratios = {}
+    if resnet_stats.get("device_only_req_per_s"):
+        ratios["resnet50"] = round(
+            req_per_s / resnet_stats["device_only_req_per_s"], 3)
+    if isinstance(llama7b, dict) and llama7b.get("decode_tok_s") \
+            and llama7b.get("device_only_tok_s"):
+        ratios["llama7b"] = round(
+            llama7b["decode_tok_s"] / llama7b["device_only_tok_s"], 3)
+    out["full_path_vs_device_only"] = ratios
     out["ledger"] = _regression_ledger(out)
     print(json.dumps(out))
 
@@ -305,6 +339,84 @@ def _relay_floor_bench() -> dict:
             float(np.percentile(dispatch, 50)) * 1e3, 2),
         "h2d_mb_s": round(len(blob) / 2**20 / min(h2d), 1),
         "d2h_mb_s": round(len(blob) / 2**20 / min(d2h), 1),
+    }
+
+
+def _h2d_roundtrip_bench() -> dict:
+    """Zero-copy data-plane micro-scenario (ISSUE 9): the same
+    dispatch→fetch round trip through the executor with the staging-slab
+    pool on vs off, plus one decode tick's control-array upload cost
+    coalesced (one packed transfer) vs per-array. When the
+    full_path_vs_device_only ratio moves, this block pins whether the
+    host-copy side (staging) or the transfer count (coalescing) moved
+    it. Absolute numbers ride the relay — compare within the run."""
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.tpu.executor import Executor
+    from gofr_tpu.tpu.staging import TransferCoalescer
+
+    container = new_mock_container()
+
+    def fn(params, x):
+        return x * params["scale"]
+
+    params = {"scale": jnp.float32(2.0)}
+    batch = 16
+    x = np.ones((batch, 64, 64, 3), np.float32)   # ~3 MB per dispatch
+
+    def roundtrip_ms(**kwargs):
+        ex = Executor(container.logger, container.metrics, **kwargs)
+        ex.register("stage_probe", fn, params, buckets=(batch,))
+        ex.predict("stage_probe", x)              # warm the bucket
+        lat = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            ex.fetch(ex.dispatch("stage_probe", x))
+            lat.append(time.perf_counter() - t0)
+        return float(np.percentile(lat, 50)) * 1e3
+
+    staged_ms = roundtrip_ms()
+    unstaged_ms = roundtrip_ms(staging=False)
+
+    # one decode tick's admission/control group (the engine ships these
+    # every tick): 7 small 4-byte arrays, ~1 KB total
+    group = {
+        "padded": np.zeros((8, 16), np.int32),
+        "lengths": np.full((8,), 16, np.int32),
+        "slots": np.arange(8, dtype=np.int32),
+        "temps": np.zeros((8,), np.float32),
+        "top_ks": np.zeros((8,), np.int32),
+        "top_ps": np.ones((8,), np.float32),
+        "seeds": np.zeros((8,), np.uint32),
+    }
+    coalescer = TransferCoalescer()
+
+    def upload_ms(f):
+        jax.block_until_ready(list(f().values()))  # warm (jit the split)
+        lat = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            jax.block_until_ready(list(f().values()))
+            lat.append(time.perf_counter() - t0)
+        return float(np.percentile(lat, 50)) * 1e3
+
+    coalesced_ms = upload_ms(lambda: coalescer.upload(group))
+    per_array_ms = upload_ms(
+        lambda: {k: jnp.asarray(v) for k, v in group.items()})
+
+    return {
+        "dispatch_roundtrip_ms_staged": round(staged_ms, 2),
+        "dispatch_roundtrip_ms_unstaged": round(unstaged_ms, 2),
+        "staged_vs_unstaged": (round(staged_ms / unstaged_ms, 2)
+                               if unstaged_ms else None),
+        "bytes_per_dispatch": x.nbytes,
+        "tick_upload_ms_coalesced": round(coalesced_ms, 3),
+        "tick_upload_ms_per_array": round(per_array_ms, 3),
+        "arrays_per_tick": len(group),
+        "data_plane": {"ingest": "in-proc ndarray",
+                       "staging": "slab-vs-off A/B"},
     }
 
 
@@ -509,6 +621,8 @@ def _resnet_bench(on_tpu: bool) -> dict:
         "operating_point": op_point,
         "bucket_sweep": sweep,
         "value_with_relay_h2d": round(batch / per_batch_relay, 1),
+        "data_plane": {"ingest": "device-resident",
+                       "staging": "n/a (inputs pre-uploaded)"},
     }
 
 
@@ -640,11 +754,16 @@ def _http_bench(on_tpu: bool) -> dict:
                        "rounds_req_per_s": [round(r, 1)
                                             for r in hello_rounds],
                        "p50_ms": hello_p50, "p99_ms": hello_p99,
-                       "clients": 32},
+                       "clients": 32,
+                       "data_plane": {"ingest": "none (empty GET)",
+                                      "staging": "n/a"}},
         "http_classify": {"req_per_s": round(cls_req_s, 1),
                           "p50_ms": cls_p50, "p99_ms": cls_p99,
                           "clients": 16, "max_batch": 16,
-                          "note": "full path incl. relay H2D"},
+                          "note": "full path incl. relay H2D",
+                          "data_plane": {
+                              "ingest": "binary (octet-stream body)",
+                              "staging": "slab (EXEC_STAGING default)"}},
         "p50_ms": cls_p50,
         "p99_ms": cls_p99,
     }
@@ -798,6 +917,8 @@ def _bert_grpc_bench(on_tpu: bool) -> dict:
         "grpc_emb_per_s_concurrency_32": round(batched, 1),
         "batching_gain": round(batched / seq, 2) if seq else None,
         "stream_ttfb_ms": {"p50": p50, "p99": p99, "samples": len(ttfbs)},
+        "data_plane": {"ingest": "json (grpc dynamic codec)",
+                       "staging": "slab (EXEC_STAGING default)"},
         "note": ("grpc path numbers include the relay per-call dispatch "
                  "floor (see `relay`); concurrency 32 shows the dynamic "
                  "batcher amortizing it across a coalesced batch"),
@@ -879,6 +1000,8 @@ def _llama_decode_bench(on_tpu: bool) -> dict:
         "ttft": {"p50_ms": p50, "p99_ms": p99, "requests": len(ttfts),
                  "note": "sequential, via HTTP SSE /generate/stream"},
         "ttft_under_load": ttft_loaded,
+        "data_plane": {"ingest": "json (HTTP /generate + SSE)",
+                       "staging": "per-array uploads (coalescer off)"},
     }
 
 
@@ -1065,6 +1188,8 @@ def _llama_prefix_reuse_bench(on_tpu: bool):
     prefix = on_stats.get("prefix_cache", {})
     return {
         "preset": preset,
+        "data_plane": {"ingest": "in-proc prompt ids",
+                       "staging": "per-array uploads (coalescer off)"},
         "shared_prefix_tokens": prefix_len,
         "page_tokens": page,
         "requests_per_pass": len(tails),
@@ -1156,6 +1281,8 @@ def _llama_paged_kv_bench(on_tpu: bool):
         "preset": preset,
         "requests_per_pass": len(prompts),
         "page_tokens": page,
+        "data_plane": {"ingest": "in-proc prompt ids",
+                       "staging": "per-array uploads (coalescer off)"},
         # determinism contract: greedy outputs identical dense vs paged
         "token_identical": dense_outs == paged_outs,
         "decode_tok_s_dense": round(dense_tok_s, 1) if dense_tok_s else None,
@@ -1276,6 +1403,8 @@ def _llama_disagg_bench(on_tpu: bool):
         "preset": preset,
         "requests_per_pass": len(prompts),
         "page_tokens": page,
+        "data_plane": {"ingest": "in-proc prompt ids",
+                       "staging": "per-array uploads (coalescer off)"},
         # determinism contract: greedy streams identical across the split
         "token_identical": mono_outs == dis_outs,
         # zero re-prefill: migrated KV became page-table entries
@@ -1361,6 +1490,8 @@ def _llama_speculative_bench(on_tpu: bool):
     return {
         "preset": preset,
         "gamma": gamma,
+        "data_plane": {"ingest": "in-proc prompt ids",
+                       "staging": "per-array uploads (coalescer off)"},
         "requests_per_pass": len(prompts),
         # determinism contract: greedy spec == greedy target-only (f32)
         "token_identical": spec_outs == ctrl_outs,
@@ -1475,6 +1606,8 @@ def _multi_model_bench(on_tpu: bool):
     return {
         "preset": preset,
         "requests_per_pass": len(prompts),
+        "data_plane": {"ingest": "in-proc prompt ids",
+                       "staging": "per-array uploads (coalescer off)"},
         "aggregate_tok_s": round(total / elapsed, 1) if elapsed else None,
         "tok_s_big": (round(tokens["big"] / elapsed, 1)
                       if elapsed else None),
@@ -1726,6 +1859,8 @@ def _llama7b_int8_bench(on_tpu: bool):
 
     roofline = engine.max_slots * hbm_bw / step_bytes
     return {"decode_tok_s": round(tok_s, 1),
+            "data_plane": {"ingest": "in-proc prompt ids",
+                           "staging": "per-array uploads (coalescer off)"},
             "prefill": prefill,
             "roofline_tok_s": round(roofline, 1),
             "roofline_frac": round(tok_s / roofline, 3),

@@ -149,6 +149,15 @@ def build_app():
             draft_cfg=draft_cfg, draft_params=draft_params,
             spec_gamma=spec_gamma,
             class_weights=class_weights,
+            # zero-copy data plane: pack each tick's small control-array
+            # uploads into ONE transfer (bit-exact bitcast split — token-
+            # identical output), and ship token deltas one coalesced
+            # queue frame per tick (docs/tpu/model-serving.md "Data
+            # plane"); both off by default pending more TPU soak time
+            coalesce_uploads=(
+                os.environ.get("GENERATE_COALESCE_UPLOADS") == "1"),
+            coalesce_stream=(
+                os.environ.get("GENERATE_COALESCE_STREAM") == "1"),
             logger=app.logger, metrics=app.container.metrics,
             # flight recorder: queue.wait/prefill/decode child spans per
             # request, engine-step spans with links, /debug/statusz views

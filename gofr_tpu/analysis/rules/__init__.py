@@ -11,6 +11,7 @@ from gofr_tpu.analysis.rules.gt003_recompile import RecompileHazardRule
 from gofr_tpu.analysis.rules.gt004_traced_effects import TracedSideEffectsRule
 from gofr_tpu.analysis.rules.gt005_metrics import MetricDisciplineRule
 from gofr_tpu.analysis.rules.gt006_kv_transfer import KVTransferSyncRule
+from gofr_tpu.analysis.rules.gt007_host_alloc import HostAllocRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -19,6 +20,7 @@ ALL_RULES = (
     TracedSideEffectsRule,
     MetricDisciplineRule,
     KVTransferSyncRule,
+    HostAllocRule,
 )
 
 

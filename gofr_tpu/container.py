@@ -199,8 +199,22 @@ class Container:
             (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
         metrics.new_histogram(
             "app_tpu_step_phase_seconds",
-            "device-step phase split: host_prep | enqueue | device_wait",
+            "device-step phase split: serialize | stage | upload | enqueue "
+            "| device_wait (host_prep replaces the first three with "
+            "EXEC_STAGING=0)",
             (0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3))
+        # zero-copy data plane (ISSUE 9): every host→device transfer —
+        # staged dispatch uploads, coalesced tick inputs, adopted KV —
+        # lands here, so the bench's relay gap is attributable per path
+        metrics.new_updown_counter(
+            "app_tpu_h2d_bytes_total",
+            "host→device bytes shipped, per path "
+            "(dispatch|rows|coalesced|mask|kv)")
+        metrics.new_histogram(
+            "app_tpu_h2d_seconds",
+            "host→device transfer wall time, per path "
+            "(dispatch|rows|coalesced|mask|kv)",
+            (0.00003, 0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1))
         # prefix-KV reuse catalog (ISSUE 4): radix-cache hit rates and the
         # prompt tokens whose prefill FLOPs the cache avoided
         metrics.new_counter(

@@ -51,6 +51,12 @@ class Request:
           or plain class, fields are set from the JSON object.
         - ``application/x-www-form-urlencoded`` → dict of first values.
         - ``multipart/form-data`` → dict of form fields + ``UploadedFile``s.
+        - ``application/x-tensor`` → zero-copy numpy **view** over the
+          socket bytes, dtype/shape from ``X-Tensor-Dtype`` /
+          ``X-Tensor-Shape`` headers — the bytes are copied exactly once
+          afterwards, into the executor's staging slab.
+        - anything else → ``memoryview`` of the raw body (no slice copies
+          downstream; ``bytes(...)`` it if you need ownership).
         """
         ctype = self.headers.get("content-type", "application/json").split(";")[0].strip()
         if ctype in ("application/json", ""):
@@ -63,11 +69,34 @@ class Request:
             data = {k: v[0] for k, v in parsed.items()}
         elif ctype == "multipart/form-data":
             data = self._parse_multipart()
+        elif ctype in ("application/x-tensor", "application/x-gofr-tensor"):
+            data = self._bind_tensor()
         else:
-            data = self.body
+            data = memoryview(self.body)
         if target is None:
             return data
         return _bind_into(target, data)
+
+    def _bind_tensor(self) -> Any:
+        """Binary tensor ingest (ISSUE 9 zero-copy data plane): interpret
+        the body as one array without copying it — ``np.frombuffer`` views
+        the socket buffer. The view is read-only; the staging slab write
+        downstream is the single host copy the request ever pays."""
+        import numpy as np
+        try:
+            dtype = np.dtype(self.headers.get("x-tensor-dtype", "uint8"))
+        except TypeError as exc:
+            raise InvalidParam(["x-tensor-dtype"]) from exc
+        shape_header = self.headers.get("x-tensor-shape", "").strip()
+        try:
+            shape = tuple(int(v) for v in shape_header.split(",") if v != "")
+        except ValueError as exc:
+            raise InvalidParam(["x-tensor-shape"]) from exc
+        try:
+            arr = np.frombuffer(self.body, dtype=dtype)
+            return arr.reshape(shape) if shape else arr
+        except ValueError as exc:
+            raise InvalidParam(["body"]) from exc
 
     def host_name(self) -> str:
         """scheme://host, honouring X-Forwarded-Proto (request.go:77-84)."""

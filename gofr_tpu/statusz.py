@@ -63,6 +63,15 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
                 status["saturation"] = saturation_fn()
             except Exception as exc:
                 status["saturation"] = {"error": repr(exc)}
+        # zero-copy data plane (ISSUE 9): staging-slab occupancy, H2D
+        # totals per path, and transfer-coalescer amortization — the
+        # live twin of app_tpu_h2d_bytes_total/_seconds
+        data_plane_fn = getattr(tpu, "data_plane", None)
+        if data_plane_fn is not None:
+            try:
+                status["data_plane"] = data_plane_fn()
+            except Exception as exc:
+                status["data_plane"] = {"error": repr(exc)}
         # compile-plane summary (ISSUE 3): totals + the serve-time-compile
         # window the watchdog acts on; the full table lives on /debug/xlaz
         ledger = getattr(tpu, "ledger", None)

@@ -167,32 +167,36 @@ class DynamicBatcher:
                     step_span.add_link(span)
         try:
             import jax
-            # graftcheck: ignore[GT001] — examples are host payloads decoded
-            # from the wire; stacking them is pure-numpy, no device sync
-            batch = jax.tree.map(
-                lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
-                *examples)
             with step_span if step_span is not None else _null_ctx():
                 if getattr(self.executor, "is_warm", None) \
                         and self.executor.is_warm(name, len(examples)):
-                    # warm path: enqueue H2D + execute right now on the loop
-                    # (both async in JAX), sync off-loop. Batch N+1's transfer
-                    # rides under batch N's execute — H2D/compute overlap.
-                    handle = self.executor.dispatch(name, batch)
+                    # warm path: write each request's rows straight into
+                    # the executor's staging slab (no intermediate np.stack
+                    # batch) and enqueue H2D + execute right now on the loop
+                    # (both async in JAX), sync off-loop. Batch N+1's
+                    # transfer rides under batch N's execute — H2D/compute
+                    # overlap with exactly one host copy per request.
+                    if getattr(self.executor, "dispatch_rows", None):
+                        handle = self.executor.dispatch_rows(name, examples)
+                    else:
+                        handle = self.executor.dispatch(
+                            name, _stack(jax, examples))
                     result = await loop.run_in_executor(
                         None, self.executor.fetch, handle)
                 else:
                     # cold path (compile) stays off-loop entirely; carry the
                     # step span's context into the worker thread so the
                     # executor can stamp its exemplar/log trace ids
+                    batch = _stack(jax, examples)
                     ctx = contextvars.copy_context()
                     result = await loop.run_in_executor(
                         None, ctx.run, self.executor.predict, name, batch)
             finished_at = time.monotonic()
             for i, future in enumerate(futures):
                 if not future.done():  # request may have timed out/gone
-                    # graftcheck: ignore[GT001] — fetch/predict returned
-                    # block_until_ready'd buffers; slicing is a host memcpy
+                    # graftcheck: ignore[GT001,GT007] — fetch/predict
+                    # returned block_until_ready'd buffers; slicing is a
+                    # host memcpy of the result, not a dispatch-path copy
                     future.set_result(
                         jax.tree.map(lambda l: np.asarray(l)[i], result))
                 if self.slo is not None:
@@ -208,6 +212,17 @@ class DynamicBatcher:
                 # math: classify every request the failed step carried
                 if self.slo is not None:
                     self.slo.record_outcome("error")
+
+
+def _stack(jax, examples):
+    """Stack per-request examples into one batch — the pre-staging-pool
+    copy, kept for cold compiles and staging-off executors."""
+    # graftcheck: ignore[GT001,GT007] — examples are host payloads decoded
+    # from the wire; stacking is pure-numpy (no device sync), and the warm
+    # path bypasses this copy via executor.dispatch_rows
+    return jax.tree.map(
+        lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
+        *examples)
 
 
 class _null_ctx:

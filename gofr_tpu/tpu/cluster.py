@@ -123,7 +123,10 @@ class HandoffTable:
             oldest = min(self._entries, key=lambda k: self._entries[k][0])
             del self._entries[oldest]
         handoff = os.urandom(8).hex()
-        self._entries[handoff] = (time.monotonic(), bytes(blob))
+        # pack() already produced owned bytes — re-copying a multi-MB KV
+        # blob here would double the handoff's host-memory footprint
+        owned = blob if isinstance(blob, bytes) else bytes(blob)
+        self._entries[handoff] = (time.monotonic(), owned)
         return handoff
 
     def get(self, handoff: str) -> bytes:

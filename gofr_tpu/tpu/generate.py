@@ -109,7 +109,7 @@ class TokenStream:
     body). HTTP/gRPC handlers can pass ``cancel`` as ``Stream.on_close``
     so even a never-started response stream releases its slot."""
 
-    __slots__ = ("_engine", "_queue", "_future", "_done")
+    __slots__ = ("_engine", "_queue", "_future", "_done", "_buffer")
 
     def __init__(self, engine: "GenerationEngine", queue: asyncio.Queue,
                  future: asyncio.Future):
@@ -117,11 +117,18 @@ class TokenStream:
         self._queue = queue
         self._future = future
         self._done = False
+        # batched token shipping (ISSUE 9): the engine may enqueue one
+        # *list* of tokens per decode tick instead of one item per token;
+        # __anext__ drains the chunk locally so per-token iteration keeps
+        # working unchanged while the queue traffic is per-tick
+        self._buffer: List[int] = []
 
     def __aiter__(self) -> "TokenStream":
         return self
 
     async def __anext__(self) -> int:
+        if self._buffer:
+            return self._buffer.pop(0)
         if self._done:
             raise StopAsyncIteration
         item = await self._queue.get()
@@ -131,7 +138,47 @@ class TokenStream:
         if isinstance(item, BaseException):
             self._finish()
             raise item
+        if isinstance(item, list):
+            self._buffer = item[1:]
+            return item[0]
         return item
+
+    async def chunks(self) -> "AsyncIterator[List[int]]":
+        """Iterate token **deltas** — every list is all tokens that landed
+        since the last yield (one decode tick's worth under
+        ``coalesce_stream``). The streaming layer ships each delta as one
+        coalesced frame instead of a frame per token."""
+        while True:
+            if self._buffer:
+                chunk, self._buffer = self._buffer, []
+                yield chunk
+                continue
+            if self._done:
+                return
+            item = await self._queue.get()
+            if item is _DONE:
+                self._finish()
+                return
+            if isinstance(item, BaseException):
+                self._finish()
+                raise item
+            chunk = item if isinstance(item, list) else [item]
+            # drain whatever else already arrived — one frame per wakeup
+            while True:
+                try:
+                    extra = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if extra is _DONE:
+                    yield chunk
+                    self._finish()
+                    return
+                if isinstance(extra, BaseException):
+                    yield chunk
+                    self._finish()
+                    raise extra
+                chunk.extend(extra if isinstance(extra, list) else [extra])
+            yield chunk
 
     def _finish(self) -> None:
         self._done = True
@@ -241,6 +288,8 @@ class GenerationEngine:
                  draft_cfg=None, draft_params=None,
                  spec_gamma: int = 4,
                  class_weights: Optional[Dict[str, float]] = None,
+                 coalesce_uploads: bool = False,
+                 coalesce_stream: bool = False,
                  logger=None, metrics=None, tracer=None, recorder=None,
                  slo=None):
         import jax
@@ -357,6 +406,17 @@ class GenerationEngine:
         self.tracer = tracer   # None → span emission off, recorder still on
         self.recorder: FlightRecorder = recorder or FlightRecorder()
         self.slo = slo         # SLOTracker: goodput/outcome accounting
+        # zero-copy data plane (ISSUE 9): the transfer coalescer packs a
+        # tick/admission's half-dozen small device uploads into ONE H2D
+        # transfer (bit-exact bitcast split on device — greedy output is
+        # token-identical either way); coalesce_stream batches token
+        # queue puts per tick instead of per token. The StagingPool here
+        # is the H2D meter shared with adopted-KV uploads.
+        from gofr_tpu.tpu.staging import StagingPool, TransferCoalescer
+        self.coalesce_uploads = bool(coalesce_uploads)
+        self.coalesce_stream = bool(coalesce_stream)
+        self._h2d = StagingPool(metrics, depth=1)
+        self._coalescer = TransferCoalescer(metrics, pool=self._h2d)
 
         if mesh is not None:
             from gofr_tpu.ops.quant import quantized_specs
@@ -1656,7 +1716,8 @@ class GenerationEngine:
             idx = np.asarray(ids, np.int32)
             key = np.asarray(payload.sample_key, np.uint32)
             with self._pool.lock:
-                pages = {name: jnp.asarray(payload.leaves[name])
+                pages = {name: self._h2d.upload(payload.leaves[name],
+                                                jnp.asarray, path="kv")
                          for name in payload.leaves}
                 (leaves, self.cache_len, self.last_token, self.temps,
                  self.top_ks, self.top_ps, self.sample_keys) = fn(
@@ -1778,6 +1839,21 @@ class GenerationEngine:
         }
         return out
 
+    def data_plane(self) -> Dict[str, Any]:
+        """Zero-copy data-plane snapshot (ISSUE 9): engine-side H2D
+        totals per path and transfer-coalescer amortization — the live
+        twin of ``app_tpu_h2d_bytes_total`` / ``app_tpu_h2d_seconds``
+        for the decode/admission path. Rendered by ``/debug/statusz``."""
+        h2d = self._h2d.stats()
+        return {
+            "coalesce_uploads": self.coalesce_uploads,
+            "coalesce_stream": self.coalesce_stream,
+            "h2d_uploads": h2d["uploads"],
+            "h2d_bytes": h2d["upload_bytes"],
+            "h2d_mb_per_s": h2d["upload_mb_per_s"],
+            "coalescer": self._coalescer.stats(),
+        }
+
     def statusz(self, recent: int = 32) -> Dict[str, Any]:
         """Live JSON snapshot for ``/debug/statusz``: admission queue depth,
         per-slot state, KV-cache occupancy, and the flight recorder's
@@ -1835,6 +1911,7 @@ class GenerationEngine:
             "ticks_inflight": self._ticks_inflight,
             "slots": slots,
             "kv_cache": kv_cache,
+            "data_plane": self.data_plane(),
             "stats": self.stats(),
             "requests": self.recorder.snapshot(limit=recent),
         }
@@ -2435,6 +2512,16 @@ class GenerationEngine:
                              top_ks=top_ks, top_ps=top_ps, seeds=seeds,
                              page_mat=page_mat, flat_ids=flat_ids,
                              plen=plen):
+                    # the group's small arrays ship BEFORE the lock (they
+                    # never alias the pool) — one coalesced transfer when
+                    # GENERATE_COALESCE_UPLOADS is on
+                    group = dict(padded=padded, lengths=lengths,
+                                 slots=slots, temps=temps, top_ks=top_ks,
+                                 top_ps=top_ps, seeds=seeds,
+                                 flat_ids=flat_ids)
+                    if p:
+                        group["page_mat"] = page_mat
+                    dev = self._upload_group(group)
                     # pool lock: a co-resident engine's donating dispatch
                     # must not interleave between our read of the leaves
                     # handle and the write-back below (tenancy safety)
@@ -2442,10 +2529,10 @@ class GenerationEngine:
                         if p == 0:
                             first, small, keys = self._prefill_fn(
                                 nb, bucket)(
-                                self.params, jnp.asarray(padded),
-                                jnp.asarray(lengths),
-                                jnp.asarray(temps), jnp.asarray(top_ks),
-                                jnp.asarray(top_ps), jnp.asarray(seeds))
+                                self.params, dev["padded"],
+                                dev["lengths"],
+                                dev["temps"], dev["top_ks"],
+                                dev["top_ps"], dev["seeds"])
                         else:
                             # suffix prefill reads the SAME pool leaves the
                             # insert below donates — PjRt usage events order
@@ -2453,21 +2540,21 @@ class GenerationEngine:
                             first, small, keys = self._suffix_prefill_fn(
                                 nb, p, bucket)(
                                 self.params, self._pool.leaves,
-                                jnp.asarray(page_mat), jnp.asarray(padded),
-                                jnp.asarray(lengths), jnp.asarray(temps),
-                                jnp.asarray(top_ks), jnp.asarray(top_ps),
-                                jnp.asarray(seeds))
+                                dev["page_mat"], dev["padded"],
+                                dev["lengths"], dev["temps"],
+                                dev["top_ks"], dev["top_ps"],
+                                dev["seeds"])
                         (leaves, self.cache_len, self.last_token,
                          self.temps, self.top_ks, self.top_ps,
                          self.sample_keys) = \
                             self._insert_paged_fn(nb, bucket, plen)(
                                 self._pool.leaves, small,
-                                jnp.asarray(flat_ids), jnp.asarray(slots),
-                                jnp.asarray(lengths), first,
+                                dev["flat_ids"], dev["slots"],
+                                dev["lengths"], first,
                                 self.cache_len, self.last_token, self.temps,
                                 self.top_ks, self.top_ps, self.sample_keys,
-                                jnp.asarray(temps), jnp.asarray(top_ks),
-                                jnp.asarray(top_ps), keys)
+                                dev["temps"], dev["top_ks"],
+                                dev["top_ps"], keys)
                         self._pool.leaves = leaves
                     self._pool.note_writes(
                         int((flat_ids != self._pool.sentinel).sum()))
@@ -2483,20 +2570,24 @@ class GenerationEngine:
                              lengths=lengths, slots=slots, temps=temps,
                              top_ks=top_ks, top_ps=top_ps, seeds=seeds,
                              publish_ids=publish_ids):
+                    dev = self._upload_group(dict(
+                        padded=padded, lengths=lengths, slots=slots,
+                        temps=temps, top_ks=top_ks, top_ps=top_ps,
+                        seeds=seeds))
                     first, small, keys = self._prefill_fn(nb, bucket)(
-                        self.params, jnp.asarray(padded),
-                        jnp.asarray(lengths),
-                        jnp.asarray(temps), jnp.asarray(top_ks),
-                        jnp.asarray(top_ps), jnp.asarray(seeds))
+                        self.params, dev["padded"],
+                        dev["lengths"],
+                        dev["temps"], dev["top_ks"],
+                        dev["top_ps"], dev["seeds"])
                     (self.cache, self.cache_len, self.last_token, self.temps,
                      self.top_ks, self.top_ps, self.sample_keys) = \
                         self._insert_fn(nb, bucket)(
-                            self.cache, small, jnp.asarray(slots),
-                            jnp.asarray(lengths), first,
+                            self.cache, small, dev["slots"],
+                            dev["lengths"], first,
                             self.cache_len, self.last_token, self.temps,
                             self.top_ks, self.top_ps, self.sample_keys,
-                            jnp.asarray(temps), jnp.asarray(top_ks),
-                            jnp.asarray(top_ps), keys)
+                            dev["temps"], dev["top_ks"],
+                            dev["top_ps"], keys)
                     if publish_ids is not None:
                         # insert does not donate `small`, so the publish
                         # scatter can read it after the insert dispatch
@@ -2512,23 +2603,27 @@ class GenerationEngine:
                              lengths=lengths, slots=slots, temps=temps,
                              top_ks=top_ks, top_ps=top_ps, seeds=seeds,
                              page_mat=page_mat):
+                    dev = self._upload_group(dict(
+                        padded=padded, lengths=lengths, slots=slots,
+                        temps=temps, top_ks=top_ks, top_ps=top_ps,
+                        seeds=seeds, page_mat=page_mat))
                     first, small, keys = self._suffix_prefill_fn(
                         nb, p, bucket)(
                         self.params, self._prefix.pool,
-                        jnp.asarray(page_mat), jnp.asarray(padded),
-                        jnp.asarray(lengths), jnp.asarray(temps),
-                        jnp.asarray(top_ks), jnp.asarray(top_ps),
-                        jnp.asarray(seeds))
+                        dev["page_mat"], dev["padded"],
+                        dev["lengths"], dev["temps"],
+                        dev["top_ks"], dev["top_ps"],
+                        dev["seeds"])
                     (self.cache, self.cache_len, self.last_token, self.temps,
                      self.top_ks, self.top_ps, self.sample_keys) = \
                         self._suffix_insert_fn(nb, p, bucket)(
                             self.cache, self._prefix.pool,
-                            jnp.asarray(page_mat), small,
-                            jnp.asarray(slots), jnp.asarray(lengths), first,
+                            dev["page_mat"], small,
+                            dev["slots"], dev["lengths"], first,
                             self.cache_len, self.last_token, self.temps,
                             self.top_ks, self.top_ps, self.sample_keys,
-                            jnp.asarray(temps), jnp.asarray(top_ks),
-                            jnp.asarray(top_ps), keys)
+                            dev["temps"], dev["top_ks"],
+                            dev["top_ps"], keys)
                     return first
 
                 warm = ((nb, p_rung, bucket) in self._suffix_prefill_fns
@@ -2538,11 +2633,14 @@ class GenerationEngine:
             if self.spec:
                 def draft_dispatch(nb=nb, db=db, draft_padded=draft_padded,
                                    draft_lengths=draft_lengths, slots=slots):
+                    dev = self._upload_group(dict(
+                        draft_padded=draft_padded,
+                        draft_lengths=draft_lengths, slots=slots))
                     small = self._draft_prefill_fn(nb, db)(
-                        self.draft_params, jnp.asarray(draft_padded),
-                        jnp.asarray(draft_lengths))
+                        self.draft_params, dev["draft_padded"],
+                        dev["draft_lengths"])
                     self._draft_cache = self._draft_insert_fn(nb, db)(
-                        self._draft_cache, small, jnp.asarray(slots))
+                        self._draft_cache, small, dev["slots"])
 
                 warm = (warm and (nb, db) in self._draft_prefill_fns
                         and (nb, db) in self._draft_insert_fns)
@@ -2602,6 +2700,26 @@ class GenerationEngine:
             if slot.req_span is not None:
                 span.add_link(slot.req_span)
         return span
+
+    def _upload_group(self, arrays: Dict[str, Any]) -> Dict[str, Any]:
+        """Ship one admission/tick group of small host arrays host→device.
+
+        With ``coalesce_uploads`` the whole group (every engine control
+        array is a 4-byte dtype) rides ONE packed transfer and is split
+        back on device with a bit-exact jitted bitcast — greedy decode is
+        token-identical with coalescing on or off. Off, each array is its
+        own metered ``jnp.asarray``. Either way the caller indexes the
+        returned dict by name, so the two paths share all dispatch code."""
+        live = {k: v for k, v in arrays.items() if v is not None}
+        if self.coalesce_uploads and len(live) > 1:
+            out = self._coalescer.upload(live)
+        else:
+            jnp = self._jnp
+            out = {k: self._h2d.upload(v, jnp.asarray, path="dispatch")
+                   for k, v in live.items()}
+        for k in arrays:
+            out.setdefault(k, None)
+        return out
 
     async def _dispatch_tick(self, loop):
         """Choose K adaptively, dispatch one decode executable, return
@@ -2668,7 +2786,8 @@ class GenerationEngine:
         # changed (H2D through a relay costs ~10ms; most ticks are stable)
         key = active.tobytes()
         if getattr(self, "_mask_key", None) != key:
-            self._mask_dev = jnp.asarray(active)
+            self._mask_dev = self._h2d.upload(active, jnp.asarray,
+                                              path="mask")
             self._mask_key = key
 
         pw = self._pick_page_width(window) if self.paged else 0
@@ -2776,7 +2895,8 @@ class GenerationEngine:
         window = self._pick_window(fills, g + 1)
         key = active.tobytes()
         if getattr(self, "_mask_key", None) != key:
-            self._mask_dev = jnp.asarray(active)
+            self._mask_dev = self._h2d.upload(active, jnp.asarray,
+                                              path="mask")
             self._mask_key = key
         pw = self._pick_page_width(window) if self.paged else 0
 
@@ -2991,6 +3111,12 @@ class GenerationEngine:
                     "decode", parent=slot.req_span)
                 slot.phase_span.set_attribute("slot", slot_idx)
         pushed = 0
+        # batched token shipping (ISSUE 9): under coalesce_stream the
+        # whole tick's delta for this slot goes onto the queue as ONE
+        # list — one wakeup, one frame — instead of a put per token.
+        # TokenStream drains it token-by-token, so consumers see the
+        # identical sequence either way.
+        chunk: Optional[List[int]] = [] if self.coalesce_stream else None
         for token in tokens:
             slot.tokens.append(token)
             slot.remaining -= 1
@@ -3000,7 +3126,10 @@ class GenerationEngine:
             if self.slo is not None:
                 self.slo.record_tokens(1)   # raw throughput, as produced
             if slot.queue is not None:
-                slot.queue.put_nowait(token)
+                if chunk is not None:
+                    chunk.append(token)
+                else:
+                    slot.queue.put_nowait(token)
             if (slot.remaining <= 0
                     or (slot.eos_id is not None and token == slot.eos_id)):
                 slot.active = False    # rest of the chunk is discarded
@@ -3017,9 +3146,14 @@ class GenerationEngine:
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_result(list(slot.tokens))
                 if slot.queue is not None:
+                    if chunk:
+                        slot.queue.put_nowait(chunk)
+                        chunk = None
                     slot.queue.put_nowait(_DONE)
                     slot.queue = None
                 break
+        if chunk and slot.queue is not None:
+            slot.queue.put_nowait(chunk)
         if pushed and self.metrics is not None:
             # per-class tick share actually delivered — the observable
             # output of WFQ admission (weights shape THIS distribution)

@@ -204,12 +204,18 @@ def pack(payload: KVPayload) -> bytes:
     return b"".join(parts)
 
 
-def unpack(data: bytes) -> KVPayload:
+def unpack(data) -> KVPayload:
     """Parse + validate one payload. Strict: any structural defect —
     short header, bad magic, version/codec mismatch, leaf size that
     disagrees with the declared geometry, or trailing garbage — raises
-    :class:`KVWireError` before a single leaf is admitted."""
-    data = bytes(data)
+    :class:`KVWireError` before a single leaf is admitted.
+
+    Zero-copy: ``data`` may be ``bytes``, ``bytearray``, or a
+    ``memoryview`` straight off the socket; leaves are ``np.frombuffer``
+    **views** into it, so the only copy on the adopt path is the H2D
+    upload. The caller must keep ``data`` alive as long as the leaves."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        data = memoryview(data)
     if len(data) < _HEAD.size:
         raise KVWireError(
             f"truncated KV payload: {len(data)} bytes < "
@@ -262,14 +268,15 @@ def unpack(data: bytes) -> KVPayload:
     return payload
 
 
-def _read_str(data: bytes, off: int, what: str) -> Tuple[str, int]:
+def _read_str(data, off: int, what: str) -> Tuple[str, int]:
     if off >= len(data):
         raise KVWireError(f"truncated KV payload at {what} length")
     n = data[off]
     off += 1
     if off + n > len(data):
         raise KVWireError(f"truncated KV payload at {what} bytes")
-    return data[off:off + n].decode("utf-8", errors="replace"), off + n
+    # bytes() here copies only the short name, never a leaf buffer
+    return bytes(data[off:off + n]).decode("utf-8", errors="replace"), off + n
 
 
 def iter_chunks(data: bytes,
@@ -286,4 +293,11 @@ def iter_chunks(data: bytes,
 
 
 def assemble(chunks: Iterable[bytes]) -> bytes:
+    """Rejoin transfer frames. A single-frame payload is returned as-is —
+    no copy — which is the common case for in-process handoffs and small
+    prompts; multi-frame payloads pay exactly one join."""
+    chunks = list(chunks)
+    if len(chunks) == 1 and isinstance(chunks[0], (bytes, bytearray)):
+        return bytes(chunks[0]) if isinstance(chunks[0], bytearray) \
+            else chunks[0]
     return b"".join(bytes(c) for c in chunks)

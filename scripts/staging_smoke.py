@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Tier-1 zero-copy data-plane smoke (ISSUE 9): one process, tiny model
+on forced host devices.
+
+Gates every commit on the two properties the staging rework must never
+break, cheap enough to run before the test sweep:
+
+1. **Token identity** — greedy decode through the generation engine is
+   token-identical with upload coalescing + batched token shipping ON
+   vs OFF (the coalescer's bitcast split is a byte reinterpretation, so
+   any divergence is a data-plane bug, not numerics).
+2. **Slab-reuse safety** — more in-flight executor dispatches than the
+   staging ring's depth on one bucket, every result still tied to its
+   own input (recycling a slab before its consuming execute finished
+   would silently corrupt batch N with batch N+1's bytes).
+
+Prints ``staging smoke: OK`` and exits 0, or raises with the failing
+property. Budget: a few seconds on 8 host CPU devices.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.executor import Executor
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    # 1. token identity: coalesced uploads + stream chunking vs plain
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    budget = 6
+
+    def build(coalesce):
+        container = new_mock_container()
+        return GenerationEngine(
+            cfg, params, max_slots=2, max_len=32, prompt_buckets=(8,),
+            coalesce_uploads=coalesce, coalesce_stream=coalesce,
+            logger=container.logger, metrics=container.metrics)
+
+    async def drive(engine):
+        await engine.start()
+        try:
+            return [await asyncio.wait_for(
+                engine.generate(p, max_new_tokens=budget), 60.0)
+                for p in prompts]
+        finally:
+            await engine.stop()
+
+    plain = asyncio.run(drive(build(False)))
+    engine = build(True)
+    coalesced = asyncio.run(drive(engine))
+    assert coalesced == plain, (
+        f"coalesced decode diverged: {coalesced} != {plain}")
+    transfers = engine.data_plane()["coalescer"]["transfers"]
+    assert transfers >= 1, "coalescer never ran — smoke tested nothing"
+
+    # 2. slab-reuse safety: 5 in-flight dispatches through a depth-2 ring
+    container = new_mock_container()
+    ex = Executor(container.logger, container.metrics, staging_depth=2)
+    import jax.numpy as jnp
+    w = jnp.arange(4, dtype=jnp.float32)
+    ex.register("probe", lambda p, x: x * 2.0 + p["w"], {"w": w},
+                buckets=(4,))
+    batches = [np.full((3, 4), float(i + 1), np.float32) for i in range(5)]
+    handles = [ex.dispatch("probe", x) for x in batches]
+    for x, handle in zip(batches, handles):
+        np.testing.assert_allclose(
+            ex.fetch(handle), x * 2.0 + np.arange(4, dtype=np.float32))
+
+    print(f"staging smoke: OK (coalesced_transfers={transfers}, "
+          f"reuse_waits={ex.data_plane()['staging']['reuse_waits']})")
+
+
+if __name__ == "__main__":
+    main()

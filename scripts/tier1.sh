@@ -15,6 +15,10 @@ env JAX_PLATFORMS=cpu python -m gofr_tpu.analysis || exit 1
 # 2-role disaggregated-serving smoke (single process, in-proc transport):
 # prefill export -> kv_wire -> decode adopt, token identity + drain
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/disagg_smoke.py || exit 1
+# zero-copy data-plane smoke: greedy token identity with upload
+# coalescing + batched token shipping on vs off, and staging-slab reuse
+# safety under more in-flight dispatches than the ring depth
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/staging_smoke.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

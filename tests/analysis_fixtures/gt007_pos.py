@@ -1,0 +1,46 @@
+"""GT007 positive fixture: per-dispatch host allocs + per-slot syncs.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import numpy as np
+
+
+class Executorish:
+    def _dispatch(self, name, batch):
+        # fresh host copy + padded copy on every dispatch
+        arr = np.asarray(batch)
+        padded = np.pad(arr, ((0, 3), (0, 0)))
+        return self._enqueue(name, padded)
+
+    def dispatch_rows(self, name, examples):
+        # stacking a fresh batch buffer per dispatch
+        batch = np.stack(examples)
+        return self._enqueue(name, batch)
+
+    def dispatch(self, name, batch):
+        # transitive: dispatch -> _prep -> host alloc
+        return self._enqueue(name, self._prep(batch))
+
+    def _prep(self, batch):
+        return np.ascontiguousarray(batch).copy()
+
+    def _enqueue(self, name, batch):
+        return (name, batch)
+
+
+class Engineish:
+    def _dispatch_tick(self, tokens_dev, slots):
+        out = []
+        for i in slots:
+            # one device->host sync per slot per tick
+            out.append(float(tokens_dev[i]))
+        while out and out[-1] < 0:
+            out.pop()
+        return out
+
+    def _admit_pending(self, tokens_dev, slots):
+        got = []
+        for i in slots:
+            got.append(tokens_dev[i].item())
+        return got

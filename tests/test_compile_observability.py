@@ -242,7 +242,11 @@ class TestStepPhases:
         fn, params = _simple_model()
         executor.register("m", fn, params, buckets=(4,))
         executor.predict("m", np.ones((3, 2), np.float32))
-        for phase in ("host_prep", "enqueue", "device_wait"):
+        # staged dispatch (the default) splits host_prep into
+        # serialize/stage/upload so the relay gap is attributable per phase
+        staged_phases = ("serialize", "stage", "upload", "enqueue",
+                         "device_wait")
+        for phase in staged_phases:
             assert container.metrics.value(
                 "app_tpu_step_phase_seconds",
                 phase=phase, model="m") == 1.0, phase
@@ -252,9 +256,18 @@ class TestStepPhases:
         assert step["model"] == "m" and step["bucket"] == 4
         assert step["batch"] == 3
         assert step["fill"] == pytest.approx(0.75)
-        assert set(step["phases"]) == {"host_prep", "enqueue",
-                                       "device_wait"}
+        assert set(step["phases"]) == set(staged_phases)
         assert all(seconds >= 0.0 for seconds in step["phases"].values())
+        # EXEC_STAGING=0 keeps the legacy host_prep anatomy
+        off_container = new_mock_container()
+        off = Executor(off_container.logger, off_container.metrics,
+                       staging=False)
+        off.register("m", fn, params, buckets=(4,))
+        off.predict("m", np.ones((3, 2), np.float32))
+        for phase in ("host_prep", "enqueue", "device_wait"):
+            assert off_container.metrics.value(
+                "app_tpu_step_phase_seconds",
+                phase=phase, model="m") == 1.0, phase
 
 
 # -- batcher flush causes + error outcome ------------------------------------

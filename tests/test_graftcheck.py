@@ -171,6 +171,36 @@ def test_gt006_negative_executor_staged_transfer_is_clean():
     assert report.new_findings == []
 
 
+# -- GT007 hot-path-host-alloc -----------------------------------------------
+
+def test_gt007_positive_flags_dispatch_allocs_and_slot_syncs():
+    report = scan("gt007_pos.py", "GT007")
+    got = keys(report)
+    assert "numpy.asarray(...) in Executorish._dispatch" in got
+    assert "numpy.pad(...) in Executorish._dispatch" in got
+    assert "numpy.stack(...) in Executorish.dispatch_rows" in got
+    # transitive: dispatch -> _prep -> alloc + copy
+    assert "numpy.ascontiguousarray(...) in Executorish._prep" in got
+    assert ".copy() in Executorish._prep" in got
+    # per-slot device syncs inside decode loops
+    assert "float(x[...]) in loop in Engineish._dispatch_tick" in got
+    assert ".item() in loop in Engineish._admit_pending" in got
+    assert all(f.rule == "GT007" for f in report.new_findings)
+
+
+def test_gt007_transitive_chain_names_dispatch_root():
+    report = scan("gt007_pos.py", "GT007")
+    chained = [f for f in report.new_findings
+               if f.key == ".copy() in Executorish._prep"]
+    assert chained and "via Executorish._prep" in chained[0].message
+
+
+def test_gt007_negative_staged_dispatch_is_clean():
+    report = scan("gt007_neg.py", "GT007")
+    assert report.new_findings == []
+    assert report.exit_code == 0
+
+
 # -- engine mechanics --------------------------------------------------------
 
 def _write_module(tmp_path, body):
@@ -295,7 +325,7 @@ def test_cli_list_rules_covers_catalog():
     for cls in ALL_RULES:
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
-        {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006"}
+        {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007"}
 
 
 def test_lint_metrics_shim_still_works():

@@ -1,0 +1,382 @@
+"""Zero-copy data plane tests (ISSUE 9): staging-slab pool, transfer
+coalescer, executor staged dispatch, engine token identity with
+coalescing on vs off, and binary tensor ingest."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.http.errors import InvalidParam
+from gofr_tpu.http.request import Request
+from gofr_tpu.models import llama
+from gofr_tpu.tpu.executor import Executor, _pad_batch
+from gofr_tpu.tpu.generate import GenerationEngine
+from gofr_tpu.tpu.staging import StagingPool, TransferCoalescer
+
+
+# -- _pad_batch fast path ----------------------------------------------------
+
+def test_pad_batch_full_bucket_is_same_object():
+    """A leaf that already fills the bucket must ride through untouched —
+    same object, zero host copies."""
+    leaf = np.arange(12, dtype=np.float32).reshape(4, 3)
+    assert _pad_batch(leaf, 4) is leaf
+
+
+def test_pad_batch_partial_bucket_zero_pads():
+    leaf = np.ones((3, 2), np.float32)
+    padded = _pad_batch(leaf, 8)
+    assert padded.shape == (8, 2)
+    np.testing.assert_array_equal(padded[:3], leaf)
+    assert not padded[3:].any()
+
+
+# -- StagingPool slab ring ---------------------------------------------------
+
+SPECS = [((4, 3), "float32"), ((4,), "int32")]
+
+
+def test_staging_pool_recycles_slab_after_waiting_on_output():
+    waits = []
+    pool = StagingPool(depth=2, wait_ready=waits.append)
+    slab = pool.acquire("k", SPECS)
+    pool.retire("k", slab, "out-a")
+    again = pool.acquire("k", SPECS)
+    # same slab handed back, but only after blocking on the execute output
+    # that proves the device consumed the previous upload
+    assert again is slab
+    assert waits == ["out-a"]
+    assert pool.stats()["reuse_waits"] == 1
+
+
+def test_staging_pool_spec_change_reallocates():
+    pool = StagingPool(depth=2, wait_ready=lambda h: None)
+    slab = pool.acquire("k", SPECS)
+    pool.retire("k", slab, "out")
+    wider = [((8, 3), "float32"), ((8,), "int32")]
+    fresh = pool.acquire("k", wider)
+    assert fresh is not slab
+    assert fresh.buffers[0].shape == (8, 3)
+    stats = pool.stats()
+    assert stats["slabs"] == {"k": 1}
+    assert stats["slab_bytes"] == sum(b.nbytes for b in fresh.buffers)
+
+
+def test_staging_pool_depth_caps_ring_growth():
+    pool = StagingPool(depth=1, wait_ready=lambda h: None)
+    slabs = [pool.acquire("k", SPECS) for _ in range(3)]
+    for slab in slabs:
+        pool.retire("k", slab, None)
+    assert pool.stats()["slabs"] == {"k": 1}
+
+
+def test_staging_pool_upload_meters_bytes():
+    container = new_mock_container()
+    pool = StagingPool(container.metrics)
+    arr = np.ones((16, 4), np.float32)
+    dev = pool.upload(arr, jnp.asarray, path="dispatch")
+    np.testing.assert_array_equal(np.asarray(dev), arr)
+    assert container.metrics.value("app_tpu_h2d_bytes_total",
+                                   path="dispatch") == arr.nbytes
+    stats = pool.stats()
+    assert stats["uploads"] == 1 and stats["upload_bytes"] == arr.nbytes
+
+
+# -- TransferCoalescer -------------------------------------------------------
+
+def test_coalescer_round_trip_is_bit_exact():
+    """One packed transfer, split on device by bitcast — every array must
+    come back bit-identical in value and dtype."""
+    arrays = {
+        "ids": np.array([[5, -7, 123456], [0, 2**31 - 1, -2**31]], np.int32),
+        "temps": np.array([0.0, 0.5, -1.25, 3.3e8], np.float32),
+        "seeds": np.array([0, 1, 2**32 - 1], np.uint32),
+    }
+    co = TransferCoalescer()
+    out = co.upload(arrays)
+    for name, host in arrays.items():
+        dev = np.asarray(out[name])
+        assert dev.dtype == host.dtype, name
+        np.testing.assert_array_equal(dev, host)
+    stats = co.stats()
+    assert stats["transfers"] == 1
+    assert stats["arrays_coalesced"] == 3
+    assert stats["bytes"] == sum(a.nbytes for a in arrays.values())
+
+
+def test_coalescer_ineligible_dtype_falls_back_per_array():
+    arrays = {
+        "ids": np.array([1, 2, 3], np.int32),
+        "half": np.array([0.5, 1.5], np.float16),  # 2-byte: not packable
+    }
+    co = TransferCoalescer()
+    out = co.upload(arrays)
+    np.testing.assert_array_equal(np.asarray(out["ids"]), arrays["ids"])
+    np.testing.assert_array_equal(np.asarray(out["half"]), arrays["half"])
+    assert co.stats()["transfers"] == 0  # fell back, never packed
+
+
+def test_coalescer_meters_into_pool():
+    container = new_mock_container()
+    pool = StagingPool(container.metrics)
+    co = TransferCoalescer(pool=pool)
+    arrays = {"a": np.zeros((8,), np.int32), "b": np.ones((4,), np.float32)}
+    co.upload(arrays)
+    total = sum(a.nbytes for a in arrays.values())
+    assert container.metrics.value("app_tpu_h2d_bytes_total",
+                                   path="coalesced") == total
+
+
+# -- Executor staged dispatch ------------------------------------------------
+
+def _double_model():
+    params = {"w": jnp.arange(4, dtype=jnp.float32)}
+
+    def fn(params, x):
+        return x * 2.0 + params["w"]
+
+    return fn, params
+
+
+def _expected(x):
+    return x * 2.0 + np.arange(4, dtype=np.float32)
+
+
+def test_staged_predict_matches_unstaged(mock_container):
+    fn, params = _double_model()
+    staged = Executor(mock_container.logger, mock_container.metrics)
+    unstaged = Executor(mock_container.logger, mock_container.metrics,
+                        staging=False)
+    for ex in (staged, unstaged):
+        ex.register("double", fn, params, buckets=(2, 4))
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_allclose(staged.predict("double", x),
+                               unstaged.predict("double", x))
+    np.testing.assert_allclose(staged.predict("double", x), _expected(x))
+
+
+def test_staged_dispatch_reports_transfer_phases(mock_container):
+    fn, params = _double_model()
+    ex = Executor(mock_container.logger, mock_container.metrics)
+    ex.register("double", fn, params, buckets=(4,))
+    handle = ex.dispatch("double", np.ones((2, 4), np.float32))
+    phases = handle[6]
+    assert set(phases) == {"serialize", "stage", "upload", "enqueue"}
+    ex.fetch(handle)
+    # staging-off path keeps the legacy host_prep phase
+    off = Executor(mock_container.logger, mock_container.metrics,
+                   staging=False)
+    off.register("double", fn, params, buckets=(4,))
+    handle = off.dispatch("double", np.ones((2, 4), np.float32))
+    assert set(handle[6]) == {"host_prep", "enqueue"}
+    off.fetch(handle)
+
+
+def test_slab_reuse_does_not_corrupt_overlapping_dispatches(mock_container):
+    """More in-flight dispatches than staging depth on one bucket: slab
+    recycling must wait for each consuming execute, so every result stays
+    tied to its own input."""
+    fn, params = _double_model()
+    ex = Executor(mock_container.logger, mock_container.metrics,
+                  staging_depth=2)
+    ex.register("double", fn, params, buckets=(4,))
+    batches = [np.full((3, 4), float(i + 1), np.float32) for i in range(5)]
+    handles = [ex.dispatch("double", x) for x in batches]
+    for x, handle in zip(batches, handles):
+        np.testing.assert_allclose(ex.fetch(handle), _expected(x))
+    staging = ex.data_plane()["staging"]
+    # one recycled slab served all five dispatches, each reuse gated on
+    # the prior execute's output
+    assert staging["slabs"] == {"('double', 4)": 1}
+    assert staging["reuse_waits"] >= 4
+
+
+def test_dispatch_rows_writes_rows_straight_into_slab(mock_container):
+    fn, params = _double_model()
+    ex = Executor(mock_container.logger, mock_container.metrics)
+    ex.register("double", fn, params, buckets=(4,))
+    rows = [np.arange(4, dtype=np.float32) * (i + 1) for i in range(3)]
+    out = ex.fetch(ex.dispatch_rows("double", rows))
+    np.testing.assert_allclose(out, _expected(np.stack(rows)))
+    assert mock_container.metrics.value("app_tpu_h2d_bytes_total",
+                                        path="rows") > 0
+
+
+def test_donation_on_is_safe_and_keeps_caller_array(mock_container):
+    """donate_inputs="on": XLA may reuse the uploaded buffer for outputs.
+    The caller's host array must be untouched and repeat dispatches must
+    stay correct (each upload is a fresh device buffer)."""
+    fn, params = _double_model()
+    ex = Executor(mock_container.logger, mock_container.metrics,
+                  donate_inputs="on")
+    ex.register("double", fn, params, buckets=(2,))
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    keep = x.copy()
+    for _ in range(3):
+        np.testing.assert_allclose(ex.predict("double", x), _expected(keep))
+    np.testing.assert_array_equal(x, keep)
+    assert ex.data_plane()["donate_inputs"] is True
+
+
+def test_executor_data_plane_snapshot(mock_container):
+    fn, params = _double_model()
+    ex = Executor(mock_container.logger, mock_container.metrics)
+    ex.register("double", fn, params, buckets=(2,))
+    ex.predict("double", np.ones((2, 4), np.float32))
+    plane = ex.data_plane()
+    assert plane["staging"]["enabled"] is True
+    assert plane["staging"]["uploads"] >= 1
+    assert plane["staging"]["upload_bytes"] > 0
+    assert mock_container.metrics.value("app_tpu_h2d_bytes_total",
+                                        path="dispatch") > 0
+    off = Executor(mock_container.logger, mock_container.metrics,
+                   staging=False)
+    assert off.data_plane()["staging"] == {"enabled": False}
+
+
+# -- Engine token identity: coalescing on vs off -----------------------------
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _make_engine(cfg, params, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    engine = GenerationEngine(cfg, params, logger=container.logger,
+                              metrics=container.metrics, **kwargs)
+    return engine, container
+
+
+def _assert_reference_identity(engine, prompts, n):
+    async def main():
+        await engine.start()
+        try:
+            outs = await asyncio.wait_for(asyncio.gather(*[
+                engine.generate(p, max_new_tokens=n) for p in prompts]),
+                120.0)
+        finally:
+            await engine.stop()
+        return outs
+    outs = asyncio.run(main())
+    cfg, params = engine.cfg, engine.params
+    for p, out in zip(prompts, outs):
+        ref = llama.generate(params, cfg, np.asarray([p], np.int32), n)
+        assert out == [int(t) for t in np.asarray(ref)[0]], p
+
+
+def test_coalesced_uploads_token_identity_dense(setup):
+    """Greedy decode must be token-identical with upload coalescing on —
+    the bitcast split is a byte reinterpretation, not a value transform."""
+    cfg, params = setup
+    engine, _ = _make_engine(cfg, params, coalesce_uploads=True)
+    _assert_reference_identity(engine, [[1, 2, 3], [4, 5], [6, 7, 8, 9]], 5)
+    plane = engine.data_plane()
+    assert plane["coalesce_uploads"] is True
+    assert plane["coalescer"]["transfers"] >= 1  # coalescing actually ran
+    assert plane["coalescer"]["arrays_per_transfer"] > 1
+
+
+def test_coalesced_uploads_token_identity_paged(setup):
+    cfg, params = setup
+    engine, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                             coalesce_uploads=True)
+    _assert_reference_identity(engine, [[1, 2, 3], [4, 5, 6, 7]], 5)
+    assert engine.data_plane()["coalescer"]["transfers"] >= 1
+
+
+def test_uncoalesced_engine_skips_coalescer(setup):
+    cfg, params = setup
+    engine, _ = _make_engine(cfg, params)
+    _assert_reference_identity(engine, [[1, 2, 3]], 4)
+    plane = engine.data_plane()
+    assert plane["coalesce_uploads"] is False
+    assert plane["coalescer"]["transfers"] == 0
+    assert plane["h2d_uploads"] >= 1  # per-array uploads still metered
+
+
+def test_coalesce_stream_identity_per_token_and_chunks(setup):
+    """Batched token shipping must not change what the client sees: the
+    per-token async iteration and the concatenation of chunk deltas both
+    equal the reference sequence."""
+    cfg, params = setup
+    prompt = [1, 2, 3, 4, 5]
+    n = 6
+    ref = llama.generate(params, cfg, np.asarray([prompt], np.int32), n)
+    expect = [int(t) for t in np.asarray(ref)[0]]
+
+    engine, _ = _make_engine(cfg, params, coalesce_stream=True)
+
+    async def main():
+        await engine.start()
+        try:
+            stream = await engine.generate_stream(prompt, max_new_tokens=n)
+            per_token = [t async for t in stream]
+            stream = await engine.generate_stream(prompt, max_new_tokens=n)
+            deltas = [chunk async for chunk in stream.chunks()]
+        finally:
+            await engine.stop()
+        return per_token, deltas
+    per_token, deltas = asyncio.run(main())
+    assert per_token == expect
+    assert [t for chunk in deltas for t in chunk] == expect
+    assert all(isinstance(c, list) and c for c in deltas)
+
+
+def test_engine_statusz_exposes_data_plane(setup):
+    cfg, params = setup
+    engine, _ = _make_engine(cfg, params, coalesce_uploads=True)
+    _assert_reference_identity(engine, [[1, 2]], 3)
+    plane = engine.statusz()["data_plane"]
+    assert plane["h2d_bytes"] > 0
+    assert set(plane["coalescer"]) == {"transfers", "arrays_coalesced",
+                                       "bytes", "arrays_per_transfer"}
+
+
+# -- Binary tensor ingest ----------------------------------------------------
+
+def _tensor_request(body, dtype="float32", shape="3,4"):
+    return Request(method="POST", path="/predict",
+                   headers={"content-type": "application/x-tensor",
+                            "x-tensor-dtype": dtype,
+                            "x-tensor-shape": shape},
+                   body=body)
+
+
+def test_binary_tensor_bind_matches_json_bind():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    bound = _tensor_request(arr.tobytes()).bind()
+    assert bound.dtype == np.float32 and bound.shape == (3, 4)
+    np.testing.assert_array_equal(bound, arr)
+    json_req = Request(headers={"content-type": "application/json"},
+                       body=json.dumps(arr.tolist()).encode())
+    np.testing.assert_array_equal(
+        np.asarray(json_req.bind(), np.float32), arr)
+
+
+def test_binary_tensor_bind_is_a_view_not_a_copy():
+    arr = np.arange(6, dtype=np.int32)
+    bound = _tensor_request(arr.tobytes(), dtype="int32", shape="6").bind()
+    # np.frombuffer over the socket bytes: read-only view, no ownership
+    assert bound.base is not None
+    assert not bound.flags.writeable
+
+
+def test_binary_tensor_bind_rejects_bad_metadata():
+    body = np.zeros(4, np.float32).tobytes()
+    with pytest.raises(InvalidParam):
+        _tensor_request(body, dtype="not-a-dtype", shape="4").bind()
+    with pytest.raises(InvalidParam):
+        _tensor_request(body, dtype="float32", shape="4,x").bind()
+    with pytest.raises(InvalidParam):  # shape/body size mismatch
+        _tensor_request(body, dtype="float32", shape="5").bind()
