@@ -55,8 +55,10 @@ class Request:
           socket bytes, dtype/shape from ``X-Tensor-Dtype`` /
           ``X-Tensor-Shape`` headers — the bytes are copied exactly once
           afterwards, into the executor's staging slab.
-        - anything else → ``memoryview`` of the raw body (no slice copies
-          downstream; ``bytes(...)`` it if you need ownership).
+        - anything else → the raw ``bytes`` body, unchanged (zero-copy
+          ingest is opted into via the tensor content types above, so
+          existing handlers that ``.decode()``/``json.loads`` the raw
+          body keep working).
         """
         ctype = self.headers.get("content-type", "application/json").split(";")[0].strip()
         if ctype in ("application/json", ""):
@@ -72,7 +74,7 @@ class Request:
         elif ctype in ("application/x-tensor", "application/x-gofr-tensor"):
             data = self._bind_tensor()
         else:
-            data = memoryview(self.body)
+            data = self.body
         if target is None:
             return data
         return _bind_into(target, data)

@@ -316,21 +316,39 @@ class Executor:
         start = time.perf_counter()
         from gofr_tpu.trace import current_span
         span = current_span()
-        rows = [self._leaves(e) for e in examples]
-        # serialize: probe one row for leaf shape/dtype; the other rows
-        # convert during the slab write itself
-        # graftcheck: ignore[GT007] — shape probe on a single row
-        probe = [r if isinstance(r, np.ndarray) else np.asarray(r)
-                 for r in rows[0]]
+        # serialize: non-ndarray leaves → arrays (identity for ndarrays,
+        # so wire-decoded numpy rows stay zero-copy here)
+        # graftcheck: ignore[GT007] — per-row conversion is the single
+        # permitted host copy; ndarray leaves pass through untouched
+        rows = [[r if isinstance(r, np.ndarray) else np.asarray(r)
+                 for r in self._leaves(e)] for e in examples]
+        nleaves = len(rows[0])
         serialized = time.perf_counter()
-        specs = [((bucket,) + p.shape, self._canon_dtype(p.dtype).name)
-                 for p in probe]
+        # slab specs must match np.stack semantics, not just row 0: equal
+        # shapes or raise, dtypes promoted across rows (then jax-
+        # canonicalized) — a silent buf[i] = row cast/broadcast would make
+        # warm (staged) and cold (stack) paths disagree on the same batch
+        for i in range(1, n):
+            if len(rows[i]) != nleaves:
+                raise ValueError(
+                    f"dispatch_rows: example {i} has {len(rows[i])} "
+                    f"leaves, example 0 has {nleaves}")
+        specs = []
+        for j in range(nleaves):
+            shape = rows[0][j].shape
+            for i in range(1, n):
+                if rows[i][j].shape != shape:
+                    raise ValueError(
+                        f"dispatch_rows: leaf {j} shape mismatch — "
+                        f"example {i} is {rows[i][j].shape}, example 0 "
+                        f"is {shape} (all rows must stack)")
+            dtype = np.result_type(*[r[j].dtype for r in rows])
+            specs.append(((bucket,) + shape, self._canon_dtype(dtype).name))
         key = (name, bucket)
         slab = self._staging.acquire(key, specs)
         for j, buf in enumerate(slab.buffers):
-            buf[0] = probe[j]
-            for i in range(1, n):
-                buf[i] = rows[i][j]  # converting write, straight into slab
+            for i in range(n):
+                buf[i] = rows[i][j]  # value-preserving cast into the slab
             if n < bucket:
                 buf[n:] = 0
         staged_at = time.perf_counter()

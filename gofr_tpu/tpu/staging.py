@@ -53,11 +53,15 @@ class StagingPool:
 
     Lifecycle per dispatch: ``acquire`` → write rows into
     ``slab.buffers`` → ``upload`` each buffer (one ``device_put``) →
-    enqueue the execute → ``retire(key, slab, out)``. ``acquire`` blocks
-    on the retired slab's execute *output* before handing the slab back
-    out — by then the device has consumed the slab's bytes, so the
-    rewrite cannot race the in-flight execute. ``depth`` slabs per key
-    give double buffering with natural backpressure.
+    enqueue the execute → ``retire(key, slab, out)``. ``acquire`` grows
+    the ring up to ``depth`` slabs before it ever waits: while the only
+    free slabs are still tied to in-flight executes and fewer than
+    ``depth`` exist, it allocates a fresh slab instead of stalling the
+    dispatcher (often the event loop) on the previous batch. Only once
+    ``depth`` slabs exist does it block — on the *oldest* slab's execute
+    output, by which point the device has consumed that slab's bytes, so
+    the rewrite cannot race the in-flight execute. ``depth`` slabs per
+    key give genuine double buffering with natural backpressure.
     """
 
     def __init__(self, metrics=None, depth: int = 2,
@@ -78,26 +82,44 @@ class StagingPool:
     # -- slab ring -----------------------------------------------------------
     def acquire(self, key: Any, specs: Sequence[LeafSpec]) -> _Slab:
         """A slab whose buffers match ``specs``, safe to write into."""
-        slab: Optional[_Slab] = None
-        with self._lock:
-            ring = self._free.setdefault(key, deque())
-            if ring:
-                slab = ring.popleft()
-        if slab is not None:
+        while True:
+            slab: Optional[_Slab] = None
+            can_grow = False
+            with self._lock:
+                ring = self._free.setdefault(key, deque())
+                if ring:
+                    slab = ring.popleft()
+                    can_grow = self._allocated.get(key, 0) < self.depth
+            if slab is None:
+                return self._alloc(key, specs)
+            if not self._matches(slab, specs):
+                # stale geometry — drop it without waiting on its execute
+                # (device_put holds its own reference to the host buffers
+                # until the copy completes)
+                self._forget(key, slab)
+                continue
             if slab.inflight is not None:
-                # the execute consuming this slab may still be reading it:
-                # wait for its output, which implies the inputs were read
+                if can_grow:
+                    # every free slab is still tied to an in-flight
+                    # execute and the ring is under depth: allocate a
+                    # fresh slab instead of stalling the dispatcher
+                    # (often the event loop) on the previous batch
+                    with self._lock:
+                        self._free[key].appendleft(slab)
+                    return self._alloc(key, specs)
+                # depth slabs exist — natural backpressure: wait for the
+                # oldest execute's output, which implies its H2D inputs
+                # were read and the slab is safe to rewrite
                 self._reuse_waits += 1
                 self._block(slab.inflight)
                 slab.inflight = None
-            if not self._matches(slab, specs):
-                self._forget(key, slab)
-                slab = None
-        if slab is None:
-            slab = _Slab(specs)
-            with self._lock:
-                self._allocated[key] = self._allocated.get(key, 0) + 1
-                self._slab_bytes += sum(b.nbytes for b in slab.buffers)
+            return slab
+
+    def _alloc(self, key: Any, specs: Sequence[LeafSpec]) -> _Slab:
+        slab = _Slab(specs)
+        with self._lock:
+            self._allocated[key] = self._allocated.get(key, 0) + 1
+            self._slab_bytes += sum(b.nbytes for b in slab.buffers)
         return slab
 
     def retire(self, key: Any, slab: _Slab, inflight: Any) -> None:
@@ -195,8 +217,14 @@ class TransferCoalescer:
 
     @classmethod
     def _eligible(cls, arrays: Dict[str, np.ndarray]) -> bool:
+        # byteorder must be native/little-endian: the device-side bitcast
+        # reinterprets bytes in little-endian order, so a '>f4' array
+        # (constructible via X-Tensor-Dtype binary ingest) would come back
+        # byte-swapped — such arrays fall back to per-array uploads, where
+        # jnp.asarray converts values correctly
         return bool(arrays) and all(
             a.dtype.itemsize == cls._ITEM and a.dtype.kind in "iuf"
+            and a.dtype.byteorder in "=<|"
             for a in arrays.values())
 
     def upload(self, arrays: Dict[str, Any]) -> Dict[str, Any]:
@@ -206,8 +234,16 @@ class TransferCoalescer:
         import jax
         import jax.numpy as jnp
 
-        host = {name: np.ascontiguousarray(a)
-                for name, a in arrays.items()}
+        host = {}
+        for name, a in arrays.items():
+            a = np.ascontiguousarray(a)
+            if a.dtype.byteorder not in "=<|":
+                # jax rejects non-native dtypes outright, and the device-
+                # side bitcast split assumes little-endian bytes — byteswap
+                # to native (value-preserving) so a '>f4' array from binary
+                # ingest uploads correctly instead of as garbage
+                a = a.astype(a.dtype.newbyteorder("="))
+            host[name] = a
         if not self._eligible(host):
             return {name: jnp.asarray(a) for name, a in host.items()}
         spec = tuple((name, a.shape, a.dtype.name) for name, a in host.items())

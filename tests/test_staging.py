@@ -43,15 +43,37 @@ SPECS = [((4, 3), "float32"), ((4,), "int32")]
 
 def test_staging_pool_recycles_slab_after_waiting_on_output():
     waits = []
-    pool = StagingPool(depth=2, wait_ready=waits.append)
+    pool = StagingPool(depth=1, wait_ready=waits.append)
     slab = pool.acquire("k", SPECS)
     pool.retire("k", slab, "out-a")
     again = pool.acquire("k", SPECS)
-    # same slab handed back, but only after blocking on the execute output
-    # that proves the device consumed the previous upload
+    # depth exhausted: same slab handed back, but only after blocking on
+    # the execute output that proves the device consumed the previous
+    # upload
     assert again is slab
     assert waits == ["out-a"]
     assert pool.stats()["reuse_waits"] == 1
+
+
+def test_staging_pool_grows_to_depth_before_blocking():
+    """Under depth, acquire must allocate a fresh slab rather than block
+    the dispatcher (often the event loop) on the previous batch's execute
+    — THIS is what makes depth=2 genuine double buffering."""
+    waits = []
+    pool = StagingPool(depth=2, wait_ready=waits.append)
+    first = pool.acquire("k", SPECS)
+    pool.retire("k", first, "out-a")
+    second = pool.acquire("k", SPECS)
+    assert second is not first   # grew the ring, no wait
+    assert waits == []
+    pool.retire("k", second, "out-b")
+    third = pool.acquire("k", SPECS)
+    # depth reached: the OLDEST slab comes back, gated on its own execute
+    assert third is first
+    assert waits == ["out-a"]
+    stats = pool.stats()
+    assert stats["slabs"] == {"k": 2}
+    assert stats["reuse_waits"] == 1
 
 
 def test_staging_pool_spec_change_reallocates():
@@ -121,6 +143,22 @@ def test_coalescer_ineligible_dtype_falls_back_per_array():
     assert co.stats()["transfers"] == 0  # fell back, never packed
 
 
+def test_coalescer_big_endian_dtype_round_trips_values():
+    """A '>f4' array (constructible via X-Tensor-Dtype binary ingest)
+    must NOT hit the little-endian bitcast split raw — it is byteswapped
+    to native first, so values (not wire byte order) reach the device."""
+    arrays = {
+        "be": np.array([1.5, -2.25, 3.0], ">f4"),
+        "ids": np.array([1, 2, 3], np.int32),
+    }
+    co = TransferCoalescer()
+    out = co.upload(arrays)
+    be = np.asarray(out["be"])
+    assert be.dtype == np.float32
+    np.testing.assert_array_equal(be, arrays["be"].astype("<f4"))
+    np.testing.assert_array_equal(np.asarray(out["ids"]), arrays["ids"])
+
+
 def test_coalescer_meters_into_pool():
     container = new_mock_container()
     pool = StagingPool(container.metrics)
@@ -178,9 +216,9 @@ def test_staged_dispatch_reports_transfer_phases(mock_container):
 
 
 def test_slab_reuse_does_not_corrupt_overlapping_dispatches(mock_container):
-    """More in-flight dispatches than staging depth on one bucket: slab
-    recycling must wait for each consuming execute, so every result stays
-    tied to its own input."""
+    """More in-flight dispatches than staging depth on one bucket: the
+    ring grows to depth, then recycling waits for each consuming execute,
+    so every result stays tied to its own input."""
     fn, params = _double_model()
     ex = Executor(mock_container.logger, mock_container.metrics,
                   staging_depth=2)
@@ -190,10 +228,10 @@ def test_slab_reuse_does_not_corrupt_overlapping_dispatches(mock_container):
     for x, handle in zip(batches, handles):
         np.testing.assert_allclose(ex.fetch(handle), _expected(x))
     staging = ex.data_plane()["staging"]
-    # one recycled slab served all five dispatches, each reuse gated on
-    # the prior execute's output
-    assert staging["slabs"] == {"('double', 4)": 1}
-    assert staging["reuse_waits"] >= 4
+    # the ring grew to depth (double buffering), never past it; from the
+    # third dispatch on, each reuse is gated on the prior execute's output
+    assert staging["slabs"] == {"('double', 4)": 2}
+    assert staging["reuse_waits"] >= 3
 
 
 def test_dispatch_rows_writes_rows_straight_into_slab(mock_container):
@@ -205,6 +243,36 @@ def test_dispatch_rows_writes_rows_straight_into_slab(mock_container):
     np.testing.assert_allclose(out, _expected(np.stack(rows)))
     assert mock_container.metrics.value("app_tpu_h2d_bytes_total",
                                         path="rows") > 0
+
+
+def test_dispatch_rows_promotes_dtypes_like_stack(mock_container):
+    """Mixed-dtype rows must promote like ``np.stack`` (then jax-
+    canonicalize), not silently cast into row 0's dtype — warm (staged)
+    and cold (stack) paths must agree on the same batch."""
+    fn, params = _double_model()
+    staged = Executor(mock_container.logger, mock_container.metrics)
+    unstaged = Executor(mock_container.logger, mock_container.metrics,
+                        staging=False)
+    for ex in (staged, unstaged):
+        ex.register("double", fn, params, buckets=(2, 4))
+    rows = [np.arange(4, dtype=np.int32),
+            np.arange(4, dtype=np.float64) + 0.25]
+    outs = [ex.fetch(ex.dispatch_rows("double", rows))
+            for ex in (staged, unstaged)]
+    np.testing.assert_allclose(outs[0], outs[1])
+    np.testing.assert_allclose(
+        outs[0], _expected(np.stack(rows).astype(np.float32)))
+
+
+def test_dispatch_rows_rejects_shape_mismatch(mock_container):
+    """Rows that would not ``np.stack`` must raise, not broadcast into
+    the slab."""
+    fn, params = _double_model()
+    ex = Executor(mock_container.logger, mock_container.metrics)
+    ex.register("double", fn, params, buckets=(4,))
+    rows = [np.ones(4, np.float32), np.ones(3, np.float32)]
+    with pytest.raises(ValueError, match="shape mismatch"):
+        ex.dispatch_rows("double", rows)
 
 
 def test_donation_on_is_safe_and_keeps_caller_array(mock_container):
@@ -370,6 +438,17 @@ def test_binary_tensor_bind_is_a_view_not_a_copy():
     # np.frombuffer over the socket bytes: read-only view, no ownership
     assert bound.base is not None
     assert not bound.flags.writeable
+
+
+def test_unknown_content_type_still_binds_raw_bytes():
+    """Zero-copy ingest is opted into via the tensor content types —
+    handlers reading an unrecognized body as ``bytes`` keep working."""
+    req = Request(method="POST", path="/raw",
+                  headers={"content-type": "application/octet-stream"},
+                  body=b"\x00\x01raw")
+    bound = req.bind()
+    assert isinstance(bound, bytes)
+    assert bound == b"\x00\x01raw"
 
 
 def test_binary_tensor_bind_rejects_bad_metadata():
