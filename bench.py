@@ -182,6 +182,8 @@ _LEDGER_PATHS = {
     "llama_disagg_decode_tok_s": ("llama_disagg", "decode_tok_s_disagg"),
     "llama_disagg_transfer_bytes_per_req": ("llama_disagg",
                                             "transfer_bytes_per_req"),
+    "llama_disagg_hbm_attributed_bytes": ("llama_disagg", "hbmz",
+                                          "attributed_bytes"),
     "resnet50_full_path_vs_device_only": ("full_path_vs_device_only",
                                           "resnet50"),
     "llama7b_full_path_vs_device_only": ("full_path_vs_device_only",
@@ -1319,6 +1321,7 @@ def _llama_disagg_bench(on_tpu: bool):
 
     import jax
 
+    from gofr_tpu.clusterz import build_clusterz
     from gofr_tpu.container import new_mock_container
     from gofr_tpu.models import llama
     from gofr_tpu.tpu.cluster import (ClusterRegistry, DisaggRouter,
@@ -1390,13 +1393,18 @@ def _llama_disagg_bench(on_tpu: bool):
                 await router.generate(prompt, max_new_tokens=budget)
             result = await drive(
                 lambda p: router.generate_stream(p, max_new_tokens=budget))
-            return result + (router.stats(), decode_eng.stats())
+            # fleet-observability snapshots ride the round's artifact so a
+            # regression in the rollup/attribution surfaces shows up in
+            # the ledger diff, not just in a failing endpoint later
+            fleet = await build_clusterz(cluster, router=router)
+            hbm = decode_eng.hbm_attribution()
+            return result + (router.stats(), decode_eng.stats(), fleet, hbm)
         finally:
             await decode_eng.stop()
 
     mono_outs, mono_tok_s, mono_ttft_ms = asyncio.run(run_monolithic())
     (dis_outs, dis_tok_s, dis_ttft_ms, router_stats,
-     decode_stats) = asyncio.run(run_disagg())
+     decode_stats, fleet, hbm) = asyncio.run(run_disagg())
 
     requests = router_stats["requests"] or 1
     return {
@@ -1418,6 +1426,19 @@ def _llama_disagg_bench(on_tpu: bool):
         "decode_tok_s_disagg": round(dis_tok_s, 1) if dis_tok_s else None,
         "transfer_bytes_per_req": round(
             router_stats["bytes_shipped"] / requests),
+        "clusterz": {
+            "roles": fleet["roles"],
+            "router": fleet["router"],
+        },
+        "hbmz": {
+            "params_bytes": hbm["params_bytes"],
+            "page_pool_bytes": (hbm["page_pool"] or {}).get("pool_bytes"),
+            "staging_bytes": hbm["staging_bytes"],
+            "attributed_bytes": hbm["attributed_bytes"],
+            "device_bytes_in_use": hbm["device_bytes_in_use"],
+            "unattributed_bytes": hbm["unattributed_bytes"],
+            "device_seconds": hbm.get("device_seconds"),
+        },
         "note": ("in-proc transport: codec + adopt scatter priced, "
                  "network not; disagg TTFT carries the transfer leg. "
                  "Compare monolithic vs disagg within this run, not "

@@ -62,7 +62,7 @@ def build_app():
                               InProcTransport, ModelRegistry,
                               ModelUnavailable, NoReplicaAvailable,
                               PagePool, kv_wire, parse_peers)
-    from gofr_tpu.tpu.cluster import HandoffTable
+    from gofr_tpu.tpu.cluster import HandoffExpired, HandoffTable
     from gofr_tpu.tpu.sched import role_class_weights
 
     app = new_app()
@@ -208,6 +208,8 @@ def build_app():
     app.enable_statusz()        # live queue/slot/KV-cache/timeline snapshot
     app.enable_varz()           # windowed SLO/goodput/saturation numbers
     app.enable_xlaz()           # compile ledger + prompt-bucket fit view
+    app.enable_hbmz()           # device-memory attribution + watchdog HBM
+    app.enable_profiler()       # duration-capped on-demand XLA captures
 
     @app.on_startup
     async def warm_engine():
@@ -353,7 +355,8 @@ def build_app():
                           engine.cfg)
     handoffs = HandoffTable(
         capacity=int(os.environ.get("DISAGG_HANDOFF_CAPACITY", "64")),
-        ttl_s=float(os.environ.get("DISAGG_HANDOFF_TTL_S", "120")))
+        ttl_s=float(os.environ.get("DISAGG_HANDOFF_TTL_S", "120")),
+        logger=app.logger, metrics=app.container.metrics)
     cluster = ClusterRegistry(logger=app.logger,
                               metrics=app.container.metrics)
     cluster.register("local", cluster_role, InProcTransport(engine))
@@ -368,6 +371,9 @@ def build_app():
     router = DisaggRouter(cluster, logger=app.logger,
                           metrics=app.container.metrics,
                           tracer=app.container.tracer)
+    app.container.cluster_router = router  # clusterz/tracez discovery
+    app.enable_clusterz()       # fleet rollup over the replica registry
+    app.enable_tracez()         # stitched per-trace_id disagg timelines
 
     def parse_sampling(get):
         """Sampling from flat key→value accessors (query params or JSON);
@@ -386,8 +392,9 @@ def build_app():
         try:
             prompt_ids = [int(t) for t in data["prompt"]]
             sampling = parse_sampling((data.get("sampling") or {}).get)
-            payload = await engine.prefill_export(prompt_ids,
-                                                  sampling=sampling)
+            payload = await engine.prefill_export(
+                prompt_ids, sampling=sampling,
+                traceparent=ctx.header("traceparent") or None)
         except KeyError as exc:
             raise BadRequest(f"missing field: {exc}") from exc
         except (TypeError, ValueError) as exc:
@@ -397,9 +404,16 @@ def build_app():
         return {"handoff": handoffs.put(blob), "bytes": len(blob),
                 "payload": payload.describe()}
 
+    class HandoffGone(HTTPError):
+        status_code = 410
+
     async def disagg_fetch(ctx):
         try:
             blob = handoffs.get(ctx.param("handoff"))
+        except HandoffExpired as exc:
+            # the id WAS real — the TTL lapsed before pickup. 410, not a
+            # generic 400: the adopting side should re-prefill, not debug
+            raise HandoffGone(str(exc)) from exc
         except KeyError as exc:
             raise BadRequest(str(exc)) from exc
         return FileResponse(content=blob)

@@ -12,6 +12,8 @@ from gofr_tpu.analysis.rules.gt004_traced_effects import TracedSideEffectsRule
 from gofr_tpu.analysis.rules.gt005_metrics import MetricDisciplineRule
 from gofr_tpu.analysis.rules.gt006_kv_transfer import KVTransferSyncRule
 from gofr_tpu.analysis.rules.gt007_host_alloc import HostAllocRule
+from gofr_tpu.analysis.rules.gt008_label_cardinality import \
+    LabelCardinalityRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -21,6 +23,7 @@ ALL_RULES = (
     MetricDisciplineRule,
     KVTransferSyncRule,
     HostAllocRule,
+    LabelCardinalityRule,
 )
 
 

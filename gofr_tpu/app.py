@@ -241,6 +241,21 @@ class App:
         from gofr_tpu.xlaz import enable_xlaz
         enable_xlaz(self, prefix)
 
+    # -- fleet rollup clusterz (no reference analog; clusterz.py) -----------
+    def enable_clusterz(self, prefix: str = "/debug/clusterz") -> None:
+        from gofr_tpu.clusterz import enable_clusterz
+        enable_clusterz(self, prefix)
+
+    # -- cross-replica trace stitching (clusterz.py) ------------------------
+    def enable_tracez(self, prefix: str = "/debug/tracez") -> None:
+        from gofr_tpu.clusterz import enable_tracez
+        enable_tracez(self, prefix)
+
+    # -- HBM attribution hbmz (no reference analog; hbmz.py) ----------------
+    def enable_hbmz(self, prefix: str = "/debug/hbmz") -> None:
+        from gofr_tpu.hbmz import enable_hbmz
+        enable_hbmz(self, prefix)
+
     # -- external DB injection (externalDB.go:5-39) -------------------------
     def add_mongo(self, client=None) -> None:
         if client is None:
@@ -285,8 +300,9 @@ class App:
 
     # -- dispatch -----------------------------------------------------------
     async def _dispatch(self, request: Request):
-        handler, params, other_method = self.router.lookup(
+        handler, params, other_method, template = self.router.lookup(
             request.method, request.path)
+        request.route = template
         if handler is None:
             if other_method:
                 from gofr_tpu.http.errors import MethodNotAllowed

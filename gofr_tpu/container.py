@@ -55,6 +55,9 @@ class Container:
         # roles, wired by the example/app when CLUSTER_ROLE/CLUSTER_PEERS
         # configure a prefill/decode split; folds into health() below
         self.cluster = None
+        # the DisaggRouter serving that cluster, when one exists — the
+        # clusterz/tracez pages discover it here (ISSUE 10)
+        self.cluster_router = None
 
         self._start_time = time.time()
 
@@ -306,6 +309,27 @@ class Container:
             "app_tpu_replica_inflight",
             "router-level in-flight requests per replica — what drain "
             "waits on")
+        # fleet observability catalog (ISSUE 10): handoff-expiry loss,
+        # device-time attribution, and the hbmz reconciliation gauges
+        metrics.new_counter(
+            "app_tpu_kv_handoff_expired_total",
+            "packed KV handoffs dropped unclaimed from the prefill "
+            "replica's table, by reason (expired = TTL lapsed, evicted = "
+            "capacity pressure) — each one is a wasted prompt forward")
+        metrics.new_updown_counter(
+            "app_tpu_device_seconds_total",
+            "dispatch→publish device step wall time attributed per "
+            "(model, SLO class), split evenly across a step's "
+            "participants — attribution, not utilization: pipelined "
+            "ticks overlap")
+        metrics.new_gauge(
+            "app_tpu_hbm_attributed_bytes",
+            "device bytes the serving stack accounts for (params + KV "
+            "page pool + staging slabs)")
+        metrics.new_gauge(
+            "app_tpu_hbm_unattributed_bytes",
+            "backend bytes_in_use minus attributed bytes — XLA "
+            "temporaries, executables, fragmentation; watch its growth")
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",

@@ -21,6 +21,11 @@ def metrics_middleware(manager: Manager) -> Middleware:
     def middleware(next_handler: WireHandler) -> WireHandler:
         async def handle(request):
             start = time.perf_counter()
+            # label by the matched route template, never the raw path: a
+            # path with an embedded id (/debug/tracez/{trace_id}) would
+            # mint one time series per request (GT008); unmatched paths
+            # collapse into one bucket for the same reason
+            route = getattr(request, "route", "") or "unmatched"
             manager.delta_updown_counter("app_http_inflight", 1.0)
             inflight_open = True
 
@@ -38,7 +43,7 @@ def metrics_middleware(manager: Manager) -> Middleware:
                 # never reach the histogram
                 manager.record_histogram(
                     "app_http_response", time.perf_counter() - start,
-                    path=request.path, method=request.method, status="500")
+                    path=route, method=request.method, status="500")
                 settle()
                 raise
             from gofr_tpu.http.response import StreamBody
@@ -50,7 +55,7 @@ def metrics_middleware(manager: Manager) -> Middleware:
                             status=status) -> None:
                     manager.record_histogram(
                         "app_http_response", time.perf_counter() - start,
-                        path=request.path, method=request.method,
+                        path=route, method=request.method,
                         status=str(status if ok else 500))
                     settle()
 
@@ -58,7 +63,7 @@ def metrics_middleware(manager: Manager) -> Middleware:
             else:
                 manager.record_histogram(
                     "app_http_response", time.perf_counter() - start,
-                    path=request.path, method=request.method,
+                    path=route, method=request.method,
                     status=str(status),
                 )
                 settle()

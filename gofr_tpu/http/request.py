@@ -25,6 +25,9 @@ class Request:
     headers: Dict[str, str] = field(default_factory=dict)  # lower-cased keys
     body: bytes = b""
     path_params: Dict[str, str] = field(default_factory=dict)
+    # matched route template (``/users/{id}``), set by dispatch; the
+    # bounded identity metrics label by — raw ``path`` is per-request
+    route: str = ""
     remote_addr: str = ""
     # set by middleware:
     context_values: Dict[str, Any] = field(default_factory=dict)

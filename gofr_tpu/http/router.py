@@ -78,24 +78,29 @@ class Router:
                        if route.match(parts) is not None})
 
     # -- dispatch -----------------------------------------------------------
-    def lookup(self, method: str, path: str) -> Tuple[Optional[WireHandler], Dict[str, str], bool]:
-        """→ (handler, path_params, path_exists_with_other_method)."""
+    def lookup(self, method: str, path: str) -> Tuple[
+            Optional[WireHandler], Dict[str, str], bool, str]:
+        """→ (handler, path_params, path_exists_with_other_method,
+        matched_route_template). The template (``/users/{id}`` rather than
+        ``/users/7``) is what metrics label by — raw paths with embedded
+        ids would mint one time series per request (GT008)."""
         method = method.upper()
         exact = self._exact.get((method, path.rstrip("/") or "/"))
         if exact is not None:
-            return exact.handler, {}, False
+            return exact.handler, {}, False, exact.template
         parts = path.strip("/").split("/") if path.strip("/") else []
         other_method = False
         for route in self._routes:
             params = route.match(parts)
             if params is not None:
                 if route.method == method:
-                    return route.handler, params, False
+                    return route.handler, params, False, route.template
                 other_method = True
         static = self._lookup_static(method, path)
         if static is not None:
-            return static, {}, False
-        return None, {}, other_method
+            handler, prefix = static
+            return handler, {}, False, prefix + "/*"
+        return None, {}, other_method, ""
 
     def wrap(self, handler: WireHandler) -> WireHandler:
         """Apply the middleware chain (first registered = outermost)."""
@@ -104,7 +109,9 @@ class Router:
             wrapped = middleware(wrapped)
         return wrapped
 
-    def _lookup_static(self, method: str, path: str) -> Optional[WireHandler]:
+    def _lookup_static(
+            self, method: str,
+            path: str) -> Optional[Tuple[WireHandler, str]]:
         if method != "GET":
             return None
         for prefix, directory in self._static_dirs:
@@ -116,7 +123,7 @@ class Router:
             if not full.startswith(root + os.sep) and full != root:
                 return None  # path traversal guard
             if os.path.isfile(full):
-                return _make_file_handler(full)
+                return _make_file_handler(full), prefix
         return None
 
 

@@ -4,11 +4,29 @@ The reference exposes no profiler (SURVEY.md §5 "no pprof endpoints");
 for a TPU serving process a trace is the first diagnostic, so the
 framework wires jax.profiler behind two admin routes:
 
-  POST /debug/profiler/start {"dir": "/tmp/trace"}   → starts a trace
+  POST /debug/profiler/start {"dir": "/tmp/trace", "duration_s": 10}
   POST /debug/profiler/stop                          → stops, returns dir
 
 The captured directory is TensorBoard/XProf-compatible. Routes are only
 registered via ``app.enable_profiler()`` — never on by default.
+
+Hardened for serving use (ISSUE 10):
+
+- **Duration cap.** Every capture auto-stops. ``duration_s`` defaults to
+  ``DEFAULT_DURATION_S`` and is clamped to ``MAX_DURATION_S`` — a
+  forgotten ``stop`` on a production replica must not trace forever
+  (jax.profiler buffers grow with the trace and a capture left running
+  degrades serving).
+- **Single flight.** One capture at a time per App; a concurrent start
+  answers 200 with ``"already profiling"`` plus the running capture's
+  dir and remaining budget, never a second ``start_trace`` (jax.profiler
+  is process-global and double-starts raise).
+- **Statusz surface.** The per-App state dict is stored as
+  ``app._profiler_state``; ``profiler_status`` renders it (running /
+  started_at / deadline / captures taken / last artifact dir) and
+  ``statusz.build_status`` embeds it, so "is someone tracing right now,
+  and where did the last trace land" is answerable without grepping
+  logs.
 
 State is per-``enable_profiler`` call (i.e. per App), not module-global:
 two App instances in one process (tests, embedded apps) must not see each
@@ -20,32 +38,106 @@ layer — but one app stopping can no longer clobber another's bookkeeping.
 from __future__ import annotations
 
 import threading
+import time
+from typing import Any, Dict, Optional
+
+DEFAULT_DURATION_S = 15.0
+MAX_DURATION_S = 120.0
+
+
+def profiler_status(state: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Render one App's profiler state for statusz. Safe on None (the
+    app never called ``enable_profiler``)."""
+    if not state:
+        return {"enabled": False}
+    out: Dict[str, Any] = {
+        "enabled": True,
+        "running": state["dir"] is not None,
+        "captures": state["captures"],
+        "last_artifact_dir": state["last_dir"],
+    }
+    if state["dir"] is not None:
+        out["dir"] = state["dir"]
+        out["started_at"] = state["started_at"]
+        if state["deadline"] is not None:
+            out["remaining_s"] = round(
+                max(0.0, state["deadline"] - time.monotonic()), 3)
+    return out
 
 
 def enable_profiler(app, prefix: str = "/debug/profiler") -> None:
-    state = {"dir": None}
+    state: Dict[str, Any] = {
+        "dir": None,          # capture in progress → its artifact dir
+        "started_at": None,   # wall clock, for the statusz surface
+        "deadline": None,     # monotonic auto-stop point
+        "timer": None,        # the auto-stop timer, cancelled on stop
+        "captures": 0,
+        "last_dir": None,     # most recent finished capture's artifacts
+    }
     lock = threading.Lock()
+    app._profiler_state = state
+
+    def _stop_locked() -> Optional[str]:
+        """Stop the running capture. Caller holds ``lock``."""
+        import jax
+        if state["dir"] is None:
+            return None
+        timer = state["timer"]
+        if timer is not None:
+            timer.cancel()
+        trace_dir = state["dir"]
+        state["dir"] = None
+        state["started_at"] = None
+        state["deadline"] = None
+        state["timer"] = None
+        state["captures"] += 1
+        state["last_dir"] = trace_dir
+        jax.profiler.stop_trace()
+        return trace_dir
+
+    def _auto_stop(trace_dir: str) -> None:
+        # runs on the timer thread — take the same lock as start/stop so
+        # a racing manual stop and the deadline can't both stop_trace
+        with lock:
+            if state["dir"] != trace_dir:
+                return   # already stopped manually
+            _stop_locked()
 
     def start(ctx):
         import jax
         body = ctx.bind() or {}
         trace_dir = body.get("dir") or "/tmp/gofr_tpu_trace"
+        try:
+            duration_s = float(body.get("duration_s") or DEFAULT_DURATION_S)
+        except (TypeError, ValueError):
+            duration_s = DEFAULT_DURATION_S
+        duration_s = max(0.1, min(duration_s, MAX_DURATION_S))
         with lock:
             if state["dir"] is not None:
                 return {"status": "already profiling",
-                        "dir": state["dir"]}
+                        "dir": state["dir"],
+                        "remaining_s": round(
+                            max(0.0, (state["deadline"] or 0.0)
+                                - time.monotonic()), 3)}
             jax.profiler.start_trace(trace_dir)
             state["dir"] = trace_dir
-        ctx.logger.info("profiler started -> %s", trace_dir)
-        return {"status": "started", "dir": trace_dir}
+            state["started_at"] = time.time()
+            state["deadline"] = time.monotonic() + duration_s
+            timer = threading.Timer(duration_s, _auto_stop, (trace_dir,))
+            timer.daemon = True
+            state["timer"] = timer
+            timer.start()
+        ctx.logger.info("profiler started -> %s (auto-stop in %.1fs)",
+                        trace_dir, duration_s)
+        return {"status": "started", "dir": trace_dir,
+                "duration_s": duration_s}
 
     def stop(ctx):
-        import jax
         with lock:
-            if state["dir"] is None:
-                return {"status": "not profiling"}
-            jax.profiler.stop_trace()
-            trace_dir, state["dir"] = state["dir"], None
+            trace_dir = _stop_locked()
+        if trace_dir is None:
+            return {"status": "not profiling",
+                    "last_artifact_dir": state["last_dir"]}
         ctx.logger.info("profiler stopped, trace in %s", trace_dir)
         return {"status": "stopped", "dir": trace_dir}
 

@@ -252,7 +252,9 @@ class Watchdog:
                  hysteresis: int = 3, min_requests: int = 1,
                  ledger: Any = None,
                  max_serving_compiles: Optional[int] = None,
-                 role: str = "both"):
+                 role: str = "both",
+                 hbm_fn: Any = None,
+                 max_hbm_occupancy: Optional[float] = None):
         self.slo = slo
         self.metrics = metrics
         self.logger = logger
@@ -271,6 +273,12 @@ class Watchdog:
         # minutes before the latency windows catch up.
         self.ledger = ledger
         self.max_serving_compiles = max_serving_compiles
+        # HBM-pressure signal (ISSUE 10): ``hbm_fn`` returns the current
+        # occupancy fraction (or None while the signal is unavailable —
+        # NOT pressure). /debug/hbmz wires it; a replica pinned above
+        # ``max_hbm_occupancy`` degrades before the allocator OOMs.
+        self.hbm_fn = hbm_fn
+        self.max_hbm_occupancy = max_hbm_occupancy
         self.window_s = window_s
         self.interval_s = interval_s
         self.hysteresis = max(1, int(hysteresis))
@@ -305,6 +313,18 @@ class Watchdog:
                 reasons.append(
                     f"recompile storm: {compiles:.0f} serve-time compiles "
                     f"in {self.window_s:.0f}s > {self.max_serving_compiles}")
+        # HBM pressure: like the recompile storm, independent of
+        # min_requests — a pool pinned full by abandoned or migrated
+        # pages is sick even when no requests terminate in the window
+        if self.hbm_fn is not None and self.max_hbm_occupancy is not None:
+            try:
+                occupancy = self.hbm_fn()
+            except Exception:
+                occupancy = None
+            if occupancy is not None and occupancy > self.max_hbm_occupancy:
+                reasons.append(
+                    f"hbm occupancy {occupancy:.3f} > "
+                    f"{self.max_hbm_occupancy}")
         self._last_reasons = reasons
         if reasons:
             self._bad_streak += 1
@@ -373,6 +393,7 @@ class Watchdog:
                 "min_attainment": self.min_attainment,
                 "max_p99_ttft_s": self.max_p99_ttft_s,
                 "max_serving_compiles": self.max_serving_compiles,
+                "max_hbm_occupancy": self.max_hbm_occupancy,
                 "window_s": self.window_s,
                 "hysteresis": self.hysteresis,
                 "min_requests": self.min_requests,
@@ -393,6 +414,11 @@ def new_watchdog(config: Any, slo: SLOTracker, metrics: Any = None,
         return None
     max_ttft_ms = config.get_float("SLO_MAX_P99_TTFT_MS", 0.0)
     max_compiles = int(config.get_float("SLO_MAX_SERVING_COMPILES", 3))
+    # SLO_MAX_HBM_OCCUPANCY (0 disables): the fraction of device memory
+    # (or KV-pool occupancy, whichever hbm_fn reports) the replica may
+    # sustain before degrading. The signal source is wired later by
+    # enable_hbmz — the threshold alone does nothing without it.
+    max_hbm = config.get_float("SLO_MAX_HBM_OCCUPANCY", 0.0)
     return Watchdog(
         slo, metrics=metrics, logger=logger,
         role=config.get_or_default("CLUSTER_ROLE", "both"),
@@ -404,4 +430,5 @@ def new_watchdog(config: Any, slo: SLOTracker, metrics: Any = None,
         min_requests=int(config.get_float("SLO_WATCHDOG_MIN_REQUESTS", 1)),
         ledger=ledger,
         max_serving_compiles=max_compiles if max_compiles > 0 else None,
+        max_hbm_occupancy=max_hbm if max_hbm > 0 else None,
     )

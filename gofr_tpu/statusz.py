@@ -38,6 +38,27 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
     if watchdog is not None:
         status["watchdog"] = watchdog.statusz()
 
+    # on-demand profiler (ISSUE 10): is a capture running, and where did
+    # the last one land — surfaced here so trace artifacts are findable
+    # without grepping logs
+    profiler_state = getattr(app, "_profiler_state", None)
+    if profiler_state is not None:
+        from gofr_tpu.profiler import profiler_status
+        status["profiler"] = profiler_status(profiler_state)
+
+    # disaggregated cluster membership (the full fleet rollup lives on
+    # /debug/clusterz; this is the local replica's registry view)
+    cluster = getattr(container, "cluster", None)
+    if cluster is not None:
+        status["cluster"] = cluster.stats()
+        router = getattr(container, "cluster_router", None)
+        if router is not None:
+            status["cluster"]["router"] = {
+                "requests": router._requests,
+                "bytes_shipped": router._bytes_shipped,
+                "kv_transfer_quantiles": router.transfer_quantiles(),
+            }
+
     batcher = getattr(container, "tpu_batcher", None)
     if batcher is not None:
         status["batcher"] = {

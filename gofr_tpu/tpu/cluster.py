@@ -42,6 +42,7 @@ import base64
 import json
 import os
 import time
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from gofr_tpu.tpu import kv_wire
@@ -57,8 +58,9 @@ ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_BOTH)
 
 __all__ = [
     "ROLE_PREFILL", "ROLE_DECODE", "ROLE_BOTH", "ROLES",
-    "NoReplicaAvailable", "HandoffTable", "InProcTransport",
-    "HTTPTransport", "ClusterRegistry", "DisaggRouter", "parse_peers",
+    "NoReplicaAvailable", "HandoffExpired", "HandoffTable",
+    "InProcTransport", "HTTPTransport", "ClusterRegistry",
+    "DisaggRouter", "parse_peers",
 ]
 
 
@@ -71,6 +73,18 @@ class NoReplicaAvailable(RuntimeError):
     def __init__(self, role: str):
         super().__init__(f"no READY replica serves role {role!r}")
         self.role = role
+
+
+class HandoffExpired(KeyError):
+    """The handoff id WAS valid but its TTL lapsed before pickup. 410
+    semantics for the HTTP layer — distinct from a never-issued id so a
+    slow router sees "you were too late", not a generic miss."""
+
+    status_code = 410
+
+    def __init__(self, handoff: str):
+        super().__init__(f"handoff {handoff!r} expired before pickup")
+        self.handoff = handoff
 
 
 def parse_peers(spec: Optional[str]) -> List[Tuple[str, str, str,
@@ -112,16 +126,24 @@ class HandoffTable:
     chunked fetch stream. Entries expire so an abandoned handoff (router
     died between prefill and fetch) cannot pin host memory."""
 
-    def __init__(self, capacity: int = 64, ttl_s: float = 120.0):
+    def __init__(self, capacity: int = 64, ttl_s: float = 120.0,
+                 logger=None, metrics=None):
         self.capacity = int(capacity)
         self.ttl_s = float(ttl_s)
+        self.logger = logger
+        self.metrics = metrics
         self._entries: Dict[str, Tuple[float, bytes]] = {}
+        # ids that aged out recently, so an adopting replica arriving late
+        # gets the precise "expired" answer instead of "unknown" (bounded:
+        # ids are 16 hex chars, not blobs)
+        self._expired: "deque[str]" = deque(maxlen=256)
+        self._expired_total = 0
 
     def put(self, blob: bytes) -> str:
         self._sweep()
         while len(self._entries) >= self.capacity:
             oldest = min(self._entries, key=lambda k: self._entries[k][0])
-            del self._entries[oldest]
+            self._drop(oldest, "evicted")
         handoff = os.urandom(8).hex()
         # pack() already produced owned bytes — re-copying a multi-MB KV
         # blob here would double the handoff's host-memory footprint
@@ -133,17 +155,36 @@ class HandoffTable:
         self._sweep()
         entry = self._entries.get(handoff)
         if entry is None:
-            raise KeyError(f"unknown or expired handoff {handoff!r}")
+            if handoff in self._expired:
+                raise HandoffExpired(handoff)
+            raise KeyError(f"unknown handoff {handoff!r}")
         return entry[1]
 
     def pop(self, handoff: str) -> None:
         self._entries.pop(handoff, None)
 
+    def _drop(self, handoff: str, why: str) -> None:
+        at, blob = self._entries.pop(handoff)
+        self._expired.append(handoff)
+        self._expired_total += 1
+        if self.logger is not None:
+            self.logger.warn(
+                "disagg: handoff %s %s after %.1fs unclaimed (%d bytes "
+                "dropped)", handoff, why, time.monotonic() - at, len(blob))
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_kv_handoff_expired_total", reason=why)
+
     def _sweep(self) -> None:
         cutoff = time.monotonic() - self.ttl_s
         for key in [k for k, (at, _) in self._entries.items()
                     if at < cutoff]:
-            del self._entries[key]
+            self._drop(key, "expired")
+
+    def stats(self) -> Dict[str, Any]:
+        return {"entries": len(self._entries),
+                "bytes": sum(len(b) for _, b in self._entries.values()),
+                "expired_total": self._expired_total}
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -168,8 +209,8 @@ class InProcTransport:
 
     async def prefill(self, prompt_ids, sampling,
                       traceparent: Optional[str] = None) -> bytes:
-        payload = await self.engine.prefill_export(prompt_ids,
-                                                   sampling=sampling)
+        payload = await self.engine.prefill_export(
+            prompt_ids, sampling=sampling, traceparent=traceparent)
         loop = asyncio.get_running_loop()
         blob = await loop.run_in_executor(None, kv_wire.pack, payload)
         return kv_wire.assemble(
@@ -181,11 +222,36 @@ class InProcTransport:
                     submitted_at: Optional[float] = None,
                     transfer_s: float = 0.0):
         loop = asyncio.get_running_loop()
+        # the unpack is the in-proc leg's share of the wire cost; fold it
+        # into the transfer figure the decode record reports
+        unpack_started = time.perf_counter()
         payload = await loop.run_in_executor(None, kv_wire.unpack, blob)
+        transfer_s += time.perf_counter() - unpack_started
         return await self.engine.adopt_kv(
             payload, max_new_tokens, eos_id=eos_id, sampling=sampling,
             submitted_at=submitted_at, traceparent=traceparent,
             transfer_s=transfer_s, transfer_bytes=len(blob))
+
+    async def observe(self) -> Dict[str, Any]:
+        """One clusterz probe: the replica's engine stats + SLO view.
+        In-proc, so this is a plain snapshot — no sockets, no awaits on
+        the serving loop."""
+        engine = self.engine
+        out: Dict[str, Any] = {"kind": self.kind,
+                               "model": getattr(engine, "model_name", None),
+                               "stats": engine.stats()}
+        health = engine.health_check()
+        out["health"] = health.get("status", "UNKNOWN")
+        slo = getattr(engine, "slo", None)
+        if slo is not None:
+            out["slo"] = slo.snapshot()
+        return out
+
+    async def tracez(self, trace_id: str) -> List[Dict[str, Any]]:
+        recorder = getattr(self.engine, "recorder", None)
+        if recorder is None:
+            return []
+        return recorder.find(trace_id)
 
     def health_check(self) -> Dict[str, Any]:
         return self.engine.health_check()
@@ -282,6 +348,26 @@ class HTTPTransport:
                 f"decode peer answered {response.status_code}: "
                 f"{response.body[:200]!r}")
         return _ListStream(response.json().get("tokens", []))
+
+    async def observe(self) -> Dict[str, Any]:
+        """One clusterz probe: the peer's ``/debug/statusz`` page, which
+        already carries engine stats, SLO snapshot, and watchdog state.
+        Raises on a non-2xx answer — the caller marks the replica stale."""
+        response = await self.service.aget("/debug/statusz",
+                                           params={"recent": 1})
+        if not response.ok:
+            raise RuntimeError(
+                f"statusz probe answered {response.status_code}")
+        peer = response.json()
+        return {"kind": self.kind, "statusz": peer,
+                "health": "UP"}
+
+    async def tracez(self, trace_id: str) -> List[Dict[str, Any]]:
+        response = await self.service.aget(
+            f"/debug/tracez/{trace_id}", params={"local": "1"})
+        if not response.ok:
+            return []
+        return response.json().get("records", [])
 
     def health_check(self) -> Dict[str, Any]:
         return self.service.health_check()
@@ -524,11 +610,16 @@ class _RelayStream:
     error, or cancellation — the count ``drain`` waits on."""
 
     def __init__(self, inner, registry: ClusterRegistry,
-                 replica: Replica):
+                 replica: Replica, on_finish=None,
+                 trace_id: Optional[str] = None):
         self._inner = inner
         self._registry = registry
         self._replica = replica
+        self._on_finish = on_finish
         self._open = True
+        # the request's stitch key: /debug/tracez/{trace_id} after this
+        # stream completes returns the assembled timeline
+        self.trace_id = trace_id
 
     def __aiter__(self) -> "_RelayStream":
         return self
@@ -544,6 +635,8 @@ class _RelayStream:
         if self._open:
             self._open = False
             self._registry.note_end(self._replica)
+            if self._on_finish is not None:
+                self._on_finish()
 
     def cancel(self) -> None:
         cancel = getattr(self._inner, "cancel", None)
@@ -562,6 +655,8 @@ class DisaggRouter:
     ``..._bytes_total``) and traced (``kv_transfer`` span carrying bytes
     shipped and both replica names)."""
 
+    STITCH_CAPACITY = 256
+
     def __init__(self, registry: ClusterRegistry, logger=None,
                  metrics=None, tracer=None):
         self.registry = registry
@@ -570,6 +665,12 @@ class DisaggRouter:
         self.tracer = tracer
         self._requests = 0
         self._bytes_shipped = 0
+        # recent transfer-leg wall times, for the clusterz quantile rollup
+        self._transfer_window: "deque[float]" = deque(maxlen=512)
+        # per-request stitch entries keyed by trace_id — the router-side
+        # half of /debug/tracez/{trace_id} (bounded ring, newest wins)
+        self._stitches: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.last_trace_id: Optional[str] = None
 
     async def generate_stream(self, prompt_ids, max_new_tokens: int,
                               eos_id: Optional[int] = None,
@@ -584,8 +685,16 @@ class DisaggRouter:
         parent = current_span() if self.tracer is not None else None
         span = (self.tracer.start_span("kv_transfer", parent=parent)
                 if self.tracer is not None else None)
-        traceparent = format_traceparent(span) if span is not None else None
-        start = time.perf_counter()
+        if span is not None:
+            traceparent = format_traceparent(span)
+            trace_id = span.trace_id
+        else:
+            # no tracer configured — synthesize a traceparent anyway so
+            # both replicas' flight records share one trace_id and the
+            # tracez stitcher still works
+            trace_id = os.urandom(16).hex()
+            traceparent = f"00-{trace_id}-{os.urandom(8).hex()}-01"
+        t0 = time.perf_counter()
         self.registry.note_start(prefiller)
         try:
             blob = await prefiller.transport.prefill(
@@ -597,24 +706,29 @@ class DisaggRouter:
             raise
         finally:
             self.registry.note_end(prefiller)
+        t1 = time.perf_counter()
         self.registry.note_start(decoder)
         try:
+            # transfer_s seeds the decode record's wire figure with the
+            # post-prefill leg only; the transport adds its own unpack
+            # share — the prefill RPC wall must NOT be folded in here
             stream = await decoder.transport.adopt(
                 blob, max_new_tokens, eos_id, sampling,
                 traceparent=traceparent, submitted_at=submitted_at,
-                transfer_s=time.perf_counter() - start)
+                transfer_s=time.perf_counter() - t1)
         except BaseException:
             self.registry.note_end(decoder)
             if span is not None:
                 span.set_status("ERROR")
                 span.finish()
             raise
-        elapsed = time.perf_counter() - start
+        t2 = time.perf_counter()
         self._requests += 1
         self._bytes_shipped += len(blob)
+        self._transfer_window.append(t2 - t1)
         if self.metrics is not None:
             self.metrics.record_histogram(
-                "app_tpu_kv_transfer_seconds", elapsed,
+                "app_tpu_kv_transfer_seconds", t2 - t1,
                 transport=decoder.transport.kind)
         if span is not None:
             span.set_attribute("bytes", len(blob))
@@ -622,7 +736,133 @@ class DisaggRouter:
             span.set_attribute("decode_replica", decoder.name)
             span.set_attribute("transport", decoder.transport.kind)
             span.finish()
-        return _RelayStream(stream, self.registry, decoder)
+        entry = {
+            "trace_id": trace_id,
+            "wall_at": time.time(),
+            "submitted_at": submitted_at,
+            "prefill_replica": prefiller.name,
+            "decode_replica": decoder.name,
+            "transport": decoder.transport.kind,
+            "prefill_rpc_s": t1 - t0,
+            "adopt_rpc_s": t2 - t1,
+            "bytes": len(blob),
+            "finished_at": None,      # set when the relay stream closes
+        }
+        self._remember(entry)
+        return _RelayStream(
+            stream, self.registry, decoder,
+            on_finish=lambda: entry.__setitem__(
+                "finished_at", time.monotonic()),
+            trace_id=entry["trace_id"])
+
+    def _remember(self, entry: Dict[str, Any]) -> None:
+        self._stitches[entry["trace_id"]] = entry
+        self._stitches.move_to_end(entry["trace_id"])
+        self.last_trace_id = entry["trace_id"]
+        while len(self._stitches) > self.STITCH_CAPACITY:
+            self._stitches.popitem(last=False)
+
+    def transfer_quantiles(self) -> Optional[Dict[str, float]]:
+        """p50/p90/p99 over the recent KV-transfer window (seconds)."""
+        if not self._transfer_window:
+            return None
+        ordered = sorted(self._transfer_window)
+        def pick(q: float) -> float:
+            idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
+            return round(ordered[idx], 6)
+        return {"count": len(ordered), "p50": pick(0.50),
+                "p90": pick(0.90), "p99": pick(0.99)}
+
+    async def trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Assemble the end-to-end timeline of one disagg request:
+        prefill → kv_transfer → handoff_gap → decode, from the router's
+        stitch entry plus both replicas' flight records.
+
+        The handoff gap is the *residual*: end-to-end wall minus the
+        measured prefill/transfer/decode phases. It appears exactly once
+        and absorbs the slack neither replica's record covers (router
+        scheduling, pack on the prefill side, decode admission wait) —
+        so the phase durations always sum to the end-to-end figure."""
+        entry = self._stitches.get(trace_id)
+        if entry is None:
+            return None
+        prefill_records = await self._replica_records(
+            entry["prefill_replica"], trace_id)
+        if entry["decode_replica"] == entry["prefill_replica"]:
+            decode_records = prefill_records
+        else:
+            decode_records = await self._replica_records(
+                entry["decode_replica"], trace_id)
+        prefill_rec = next(
+            (r for r in prefill_records if r.get("status") == "exported"),
+            None)
+        decode_rec = next(
+            (r for r in decode_records
+             if r.get("kv_transfer_bytes") and r.get("status") != "exported"),
+            None)
+        finished_at = entry["finished_at"]
+        e2e = ((finished_at if finished_at is not None
+                else time.monotonic()) - entry["submitted_at"])
+        e2e = max(e2e, 0.0)
+
+        def _rec_duration(rec, start_key="enqueued_at") -> Optional[float]:
+            timing = (rec or {}).get("timing") or {}
+            start = timing.get(start_key)
+            end = timing.get("finished_at")
+            if start is None or end is None:
+                return None
+            return max(0.0, end - start)
+
+        prefill_s = _rec_duration(prefill_rec)
+        if prefill_s is None:
+            prefill_s = entry["prefill_rpc_s"]
+        prefill_s = min(prefill_s, e2e)
+        decode_s = _rec_duration(decode_rec)
+        if decode_s is None:
+            decode_s = max(0.0, e2e - entry["prefill_rpc_s"]
+                           - entry["adopt_rpc_s"])
+        decode_s = min(decode_s, max(0.0, e2e - prefill_s))
+        transfer_s = (decode_rec or {}).get("kv_transfer_s")
+        if transfer_s is None:
+            transfer_s = entry["adopt_rpc_s"]
+        transfer_s = min(transfer_s, max(0.0, e2e - prefill_s - decode_s))
+        gap_s = max(0.0, e2e - prefill_s - transfer_s - decode_s)
+        phases = [
+            {"name": "prefill", "replica": entry["prefill_replica"],
+             "duration_s": round(prefill_s, 6)},
+            {"name": "kv_transfer", "transport": entry["transport"],
+             "bytes": entry["bytes"], "duration_s": round(transfer_s, 6)},
+            {"name": "handoff_gap", "duration_s": round(gap_s, 6)},
+            {"name": "decode", "replica": entry["decode_replica"],
+             "duration_s": round(decode_s, 6)},
+        ]
+        return {
+            "trace_id": trace_id,
+            "stitched": True,
+            "wall_at": entry["wall_at"],
+            "in_flight": finished_at is None,
+            "prefill_replica": entry["prefill_replica"],
+            "decode_replica": entry["decode_replica"],
+            "transport": entry["transport"],
+            "bytes": entry["bytes"],
+            "e2e_s": round(e2e, 6),
+            "phases": phases,
+            "records": {"prefill": prefill_records,
+                        "decode": decode_records},
+        }
+
+    async def _replica_records(self, name: str,
+                               trace_id: str) -> List[Dict[str, Any]]:
+        replica = self.registry._replicas.get(name)
+        if replica is None:
+            return []
+        tracez = getattr(replica.transport, "tracez", None)
+        if tracez is None:
+            return []
+        try:
+            return await tracez(trace_id)
+        except Exception:
+            return []
 
     async def generate(self, prompt_ids, max_new_tokens: int,
                        eos_id: Optional[int] = None,
@@ -639,5 +879,7 @@ class DisaggRouter:
         return {
             "requests": self._requests,
             "bytes_shipped": self._bytes_shipped,
+            "kv_transfer_quantiles": self.transfer_quantiles(),
+            "stitched_traces": len(self._stitches),
             "cluster": self.registry.stats(),
         }

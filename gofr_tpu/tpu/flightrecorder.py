@@ -180,6 +180,30 @@ class FlightRecorder:
             self._total_steps += 1
             self._steps.append(entry)
 
+    def find(self, trace_id: str) -> List[Dict[str, Any]]:
+        """All records (in-flight + completed) tagged with ``trace_id``,
+        oldest first. Each dict is :meth:`RequestRecord.to_dict` plus a
+        ``timing`` block of raw monotonic timestamps so a cross-replica
+        stitcher can do gap math on same-clock records (ISSUE 10)."""
+        with self._lock:
+            records = [r for r in self._inflight.values()
+                       if r.trace_id == trace_id]
+            records += [r for r in self._completed if r.trace_id == trace_id]
+        records.sort(key=lambda r: r.enqueued_at)
+        out = []
+        for r in records:
+            d = r.to_dict()
+            end = r.finished_at if r.finished_at is not None else time.monotonic()
+            d["timing"] = {
+                "enqueued_at": r.enqueued_at,
+                "admitted_at": r.admitted_at,
+                "first_token_at": r.first_token_at,
+                "finished_at": r.finished_at,
+                "duration_s": round(end - r.enqueued_at, 6),
+            }
+            out.append(d)
+        return out
+
     def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
         with self._lock:
             inflight = [r.to_dict() for r in self._inflight.values()]

@@ -62,7 +62,7 @@ from gofr_tpu.tpu.compile_ledger import ShapeStats, suggest_ladder
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.tpu.sched import (ClassQueues, DEFAULT_CLASS_WEIGHTS,
                                 deadline_class)
-from gofr_tpu.trace import Span, current_span
+from gofr_tpu.trace import Span, current_span, extract_traceparent
 
 DEFAULT_PROMPT_BUCKETS = (32, 128, 512)
 
@@ -255,8 +255,10 @@ class _Fetch:
     "tick" (payload: [(slot, gen)]), or "spec" (payload: ([(slot, gen)],
     gamma); the fetch lands (tokens, accept_counts)). ``span`` is the open
     engine-step span (dispatch → publish), finished when the fetch
-    lands."""
-    __slots__ = ("task", "kind", "payload", "span")
+    lands. ``dispatched_at`` anchors device-time attribution: dispatch →
+    publish wall time is charged to the participating requests' {model,
+    slo class} (ISSUE 10)."""
+    __slots__ = ("task", "kind", "payload", "span", "dispatched_at")
 
     def __init__(self, task, kind: str, payload,
                  span: Optional[Span] = None):
@@ -264,6 +266,7 @@ class _Fetch:
         self.kind = kind
         self.payload = payload
         self.span = span
+        self.dispatched_at = time.monotonic()
 
 
 class GenerationEngine:
@@ -591,6 +594,11 @@ class GenerationEngine:
         self._adopt_fns: Dict[int, Any] = {}
         self._kv_exports = 0
         self._kv_adoptions = 0
+        # device-time attribution (ISSUE 10): dispatch→publish wall time
+        # split evenly across a step's participating slots and charged to
+        # {model, slo class}. Attribution, not utilization — pipelined
+        # ticks overlap, so the shares can sum past wall-clock time.
+        self._device_seconds: Dict[Tuple[str, str], float] = {}
         self._prefill_bucket_tokens = 0   # bucket rows*cols dispatched to
         self._prefill_real_tokens = 0     # prefill vs real prompt tokens
         self._prefix = None
@@ -1479,7 +1487,8 @@ class GenerationEngine:
 
     # -- disaggregated serving: prefill export / KV adoption (ISSUE 8) ------
     async def prefill_export(self, prompt_ids,
-                             sampling: Optional[Sampling] = None):
+                             sampling: Optional[Sampling] = None,
+                             traceparent: Optional[str] = None):
         """Prefill-replica half of the disaggregated handoff: run the
         prompt forward ONCE and export its KV as a page-aligned
         :class:`~gofr_tpu.tpu.kv_wire.KVPayload` instead of inserting it
@@ -1501,12 +1510,22 @@ class GenerationEngine:
         page = self.kv_page
         n_pages = -(-len(prompt) // page)
         jnp, cfg = self._jnp, self.cfg
-        parent = current_span() if self.tracer is not None else None
-        span = (self.tracer.start_span("prefill.export", parent=parent)
-                if self.tracer is not None else None)
+        # a router-supplied traceparent joins this export to the disagg
+        # request's trace — same remote-parent rule as adopt_kv, so the
+        # prefill and decode flight records share one trace_id and the
+        # tracez stitcher can find both halves
+        remote = extract_traceparent(traceparent) if traceparent else None
+        span = None
+        if self.tracer is not None:
+            parent = current_span()
+            span = self.tracer.start_span("prefill.export", parent=parent,
+                                          remote_parent=remote)
+        trace_id = span.trace_id if span is not None else None
+        if trace_id is None and remote is not None:
+            trace_id = remote.get("trace_id")
         record = RequestRecord(
             model=self.model_name, prompt_len=len(prompt), budget=1,
-            trace_id=span.trace_id if span is not None else None,
+            trace_id=trace_id,
             span_id=span.span_id if span is not None else None)
         self.recorder.start(record)
         record.admitted()
@@ -1647,10 +1666,8 @@ class GenerationEngine:
         # observability: the adopt span joins the remote prefill trace
         # when the transport forwarded a traceparent
         span = None
+        remote = extract_traceparent(traceparent) if traceparent else None
         if self.tracer is not None:
-            from gofr_tpu.trace.tracer import extract_traceparent
-            remote = extract_traceparent(traceparent) if traceparent \
-                else None
             span = self.tracer.start_span(
                 "kv_adopt", remote_parent=remote,
                 parent=None if remote else current_span())
@@ -1658,10 +1675,15 @@ class GenerationEngine:
             span.set_attribute("pages", need)
             if transfer_bytes:
                 span.set_attribute("transfer_bytes", transfer_bytes)
+        trace_id = span.trace_id if span is not None else None
+        if trace_id is None and remote is not None:
+            # tracer disabled: still tag the record with the router's
+            # trace_id so the tracez stitcher finds this half
+            trace_id = remote.get("trace_id")
         record = RequestRecord(
             model=self.model_name, prompt_len=payload.tokens,
             budget=max_new_tokens,
-            trace_id=span.trace_id if span is not None else None,
+            trace_id=trace_id,
             span_id=span.span_id if span is not None else None)
         self.recorder.start(record)
         record.admitted()
@@ -1808,7 +1830,11 @@ class GenerationEngine:
                "max_len": self.max_len,
                "window_ladder": [w or self.max_len
                                  for w in self._window_ladder],
-               "mesh": dict(self.mesh.shape) if self.mesh else None}
+               "mesh": dict(self.mesh.shape) if self.mesh else None,
+               "device_seconds": {
+                   f"{model}/{cls}": round(seconds, 6)
+                   for (model, cls), seconds
+                   in sorted(self._device_seconds.items())}}
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
             out["prefix_cache"]["page_ladder"] = list(self._p_ladder)
@@ -1853,6 +1879,93 @@ class GenerationEngine:
             "h2d_mb_per_s": h2d["upload_mb_per_s"],
             "coalescer": self._coalescer.stats(),
         }
+
+    def hbm_attribution(self) -> Dict[str, Any]:
+        """Device-memory attribution for ``/debug/hbmz`` (ISSUE 10):
+        reconcile what this engine KNOWS it placed on device — params,
+        the KV page pool split by ownership class, staging slabs —
+        against the backend's ``memory_stats()`` figure. The residual is
+        what nobody claims (XLA temp buffers, executables, fragmentation)
+        and is the honest "unattributed" line, not an error. Pure host
+        bookkeeping — no device syncs."""
+        from gofr_tpu.tpu.sched import CLASS_MIGRATED
+        tree_leaves = self._jax.tree_util.tree_leaves
+        if getattr(self, "_params_nbytes", None) is None:
+            nbytes = sum(getattr(leaf, "nbytes", 0)
+                         for leaf in tree_leaves(self.params))
+            if self.draft_params is not None:
+                nbytes += sum(getattr(leaf, "nbytes", 0)
+                              for leaf in tree_leaves(self.draft_params))
+            self._params_nbytes = int(nbytes)
+        out: Dict[str, Any] = {
+            "model": self.model_name,
+            "params_bytes": self._params_nbytes,
+        }
+        pool_section: Dict[str, Any] = {}
+        attributed = self._params_nbytes
+        if self.paged and self._pool is not None:
+            pool = self._pool
+            page_bytes = pool.page_bytes
+            decode_pages = migrated_pages = 0
+            for slot in self._slots:
+                if not slot.active:
+                    continue
+                held = len(slot.pages)
+                if slot.cls == CLASS_MIGRATED:
+                    migrated_pages += held
+                else:
+                    decode_pages += held
+            used = pool.used_pages
+            # pages in use but held by no slot are prefix-cache pins
+            # (trie-owned); clip covers the race between a slot release
+            # and the pool's counter catching up
+            prefix_pages = max(0, used - decode_pages - migrated_pages)
+            pool_section = {
+                "pool_bytes": pool.pool_bytes,
+                "page_bytes": page_bytes,
+                "pages": {"total": pool.num_pages,
+                          "free": pool.free_pages,
+                          "decode": decode_pages,
+                          "migrated": migrated_pages,
+                          "prefix_pinned": prefix_pages},
+                "bytes": {"free": pool.free_pages * page_bytes,
+                          "decode": decode_pages * page_bytes,
+                          "migrated": migrated_pages * page_bytes,
+                          "prefix_pinned": prefix_pages * page_bytes},
+            }
+            attributed += pool.pool_bytes
+        out["page_pool"] = pool_section or None
+        staging_bytes = int(self._h2d.stats().get("slab_bytes", 0))
+        out["staging_bytes"] = staging_bytes
+        attributed += staging_bytes
+        out["attributed_bytes"] = attributed
+        out["device_bytes_in_use"] = self.device_bytes_in_use()
+        if out["device_bytes_in_use"] is not None:
+            out["unattributed_bytes"] = (
+                out["device_bytes_in_use"] - attributed)
+        else:
+            out["unattributed_bytes"] = None
+        out["device_seconds"] = {
+            f"{model}/{cls}": round(seconds, 6)
+            for (model, cls), seconds
+            in sorted(self._device_seconds.items())}
+        return out
+
+    def device_bytes_in_use(self) -> Optional[int]:
+        """Backend-reported bytes in use, summed over local devices.
+        ``None`` when the backend exposes no ``memory_stats`` (some CPU
+        builds) — callers render "unknown" rather than a fake zero."""
+        total = 0
+        seen = False
+        for device in self._jax.local_devices():
+            try:
+                stats = device.memory_stats() or {}
+            except Exception:
+                continue
+            if "bytes_in_use" in stats:
+                total += int(stats["bytes_in_use"])
+                seen = True
+        return total if seen else None
 
     def statusz(self, recent: int = 32) -> Dict[str, Any]:
         """Live JSON snapshot for ``/debug/statusz``: admission queue depth,
@@ -2150,7 +2263,34 @@ class GenerationEngine:
             entry = q.popleft()
             self._publish(entry, entry.task.result())
 
+    def _attribute_device_time(self, entry: _Fetch) -> None:
+        """Charge the step's dispatch→publish wall time to the
+        participating requests' {model, slo class}, split evenly. Feeds
+        ``app_tpu_device_seconds_total`` and the hbmz/clusterz rollups."""
+        elapsed = time.monotonic() - entry.dispatched_at
+        if elapsed <= 0:
+            return
+        if entry.kind == "spec":
+            participants = [s for s, _ in entry.payload[0]]
+        elif entry.kind == "prefill":
+            participants = [s for s, _, _ in entry.payload]
+        else:
+            participants = [s for s, _ in entry.payload]
+        if not participants:
+            return
+        share = elapsed / len(participants)
+        for slot_idx in participants:
+            cls = getattr(self._slots[slot_idx], "cls", None) or "standard"
+            key = (self.model_name, cls)
+            self._device_seconds[key] = (
+                self._device_seconds.get(key, 0.0) + share)
+            if self.metrics is not None:
+                self.metrics.delta_updown_counter(
+                    "app_tpu_device_seconds_total", share,
+                    model=self.model_name, cls=cls)
+
     def _publish(self, entry: _Fetch, host) -> None:
+        self._attribute_device_time(entry)
         if entry.kind == "prefill":
             for slot_idx, gen, row in entry.payload:
                 self._push_tokens(slot_idx, gen, [int(host[row])])
@@ -2659,9 +2799,10 @@ class GenerationEngine:
                                             bucket=bucket, padded_batch=nb,
                                             prefix_pages=p_rung)
                 if warm:
-                    first_dev = dispatch()
-                    if draft_dispatch is not None:
-                        draft_dispatch()
+                    with self._profile_step("tpu.engine.prefill"):
+                        first_dev = dispatch()
+                        if draft_dispatch is not None:
+                            draft_dispatch()
                 else:
                     def cold(dispatch=dispatch,
                              draft_dispatch=draft_dispatch):
@@ -2679,6 +2820,14 @@ class GenerationEngine:
                 self._prefix.release(leases)
         self._set_queue_gauges()
         return fetches
+
+    def _profile_step(self, name: str):
+        """``StepTraceAnnotation`` for the on-demand profiler (ISSUE 10):
+        when a ``/debug/profiler`` capture is live, each dispatched step
+        shows up named and numbered in the XProf timeline; with no
+        capture active it is a nanosecond-cheap TraceMe no-op."""
+        return self._jax.profiler.StepTraceAnnotation(
+            name, step_num=self._steps)
 
     def _step_span(self, name: str, participants,
                    **attributes) -> Optional[Span]:
@@ -2833,7 +2982,8 @@ class GenerationEngine:
         warm = ((k, sampled, pw) in self._decode_paged_fns if self.paged
                 else (k, sampled, window) in self._decode_fns)
         if warm:
-            tokens_dev = dispatch()
+            with self._profile_step("tpu.engine.step"):
+                tokens_dev = dispatch()
         else:
             tokens_dev = await loop.run_in_executor(None, dispatch)
         self._steps += 1

@@ -293,6 +293,55 @@ class ModelRegistry:
             out["shared_pool"] = self.page_pool.stats()
         return out
 
+    def hbm_attribution(self) -> Dict[str, Any]:
+        """Fleet-of-models HBM attribution (ISSUE 10): per-model params +
+        pool + staging figures merged into one page, with a SHARED page
+        pool counted exactly once (each co-resident engine reports the
+        same pool object; double-counting it would fabricate HBM). The
+        budget's carve-vs-actual table rides along when carves exist."""
+        models: Dict[str, Any] = {}
+        attributed = 0
+        pools_seen: set = set()
+        device_bytes = None
+        for name, entry in self._entries.items():
+            engine = entry.engine
+            attribution = getattr(engine, "hbm_attribution", None)
+            if attribution is None:
+                continue
+            report = attribution()
+            models[name] = report
+            attributed += report["params_bytes"]
+            attributed += report["staging_bytes"]
+            pool = getattr(engine, "_pool", None)
+            if report.get("page_pool") and pool is not None \
+                    and id(pool) not in pools_seen:
+                pools_seen.add(id(pool))
+                attributed += report["page_pool"]["pool_bytes"]
+            if device_bytes is None:
+                device_bytes = report.get("device_bytes_in_use")
+        out: Dict[str, Any] = {
+            "models": models,
+            "attributed_bytes": attributed,
+            "device_bytes_in_use": device_bytes,
+            "unattributed_bytes": (device_bytes - attributed
+                                   if device_bytes is not None else None),
+        }
+        if self.hbm_budget is not None:
+            budget = self.hbm_budget.stats()
+            out["hbm_budget"] = budget
+            # carve-vs-actual: what each model reserved at registration
+            # vs what its engine attributes right now
+            out["carve_vs_actual"] = {
+                name: {"carved_bytes": carved,
+                       "actual_bytes": (
+                           models[name]["params_bytes"]
+                           + models[name]["staging_bytes"]
+                           + ((models[name].get("page_pool") or {})
+                              .get("pool_bytes", 0))
+                           if name in models else None)}
+                for name, carved in budget.get("carves", {}).items()}
+        return out
+
     def xlaz(self, recent: int = 64) -> Dict[str, Any]:
         # keyed "engines" (not "models"): each engine's own xlaz already
         # uses a "models" key for its shape ladders

@@ -51,6 +51,21 @@ def build_varz(app) -> Dict[str, Any]:
     if engine is not None and not hasattr(engine, "saturation"):
         varz["engine"] = engine.stats()
 
+    # HBM + device-time attribution summary (ISSUE 10): the headline
+    # numbers from /debug/hbmz, inlined so one varz scrape carries them
+    if tpu is not None and hasattr(tpu, "hbm_attribution"):
+        try:
+            report = tpu.hbm_attribution()
+            varz["hbm"] = {
+                "attributed_bytes": report.get("attributed_bytes"),
+                "device_bytes_in_use": report.get("device_bytes_in_use"),
+                "unattributed_bytes": report.get("unattributed_bytes"),
+            }
+            if report.get("device_seconds"):
+                varz["device_seconds"] = report["device_seconds"]
+        except Exception as exc:
+            varz["hbm"] = {"error": repr(exc)}
+
     return varz
 
 

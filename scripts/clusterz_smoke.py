@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Tier-1 fleet-observability smoke: clusterz rollup + trace stitching.
+
+Builds the same two-role in-proc cluster as disagg_smoke.py (dense
+prefill replica, paged decode replica) plus one replica behind an open
+circuit, drives one request through the DisaggRouter, then asserts the
+ISSUE 10 observability surfaces built on top of it:
+
+1. ``DisaggRouter.trace`` (the ``/debug/tracez/{trace_id}`` builder)
+   returns ONE stitched timeline whose phases — prefill, kv_transfer,
+   handoff_gap, decode — sum to within 10% of the observed end-to-end
+   latency, with the handoff gap appearing exactly once.
+2. ``build_clusterz`` reports both live replicas with role rollups and
+   marks the circuit-open replica stale instead of failing the page.
+3. ``build_hbmz`` attributes device memory with an unattributed
+   residual below 10% of bytes-in-use (when the backend reports memory
+   stats at all; host CPU may not).
+
+Prints ``clusterz smoke: OK`` and exits 0, or raises with the failing
+property. Budget: a few seconds on 8 host CPU devices.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+class _OpenCircuit:
+    """A replica whose circuit breaker is open: clusterz must mark it
+    stale WITHOUT probing (observe() here raising is the proof)."""
+
+    kind = "http"
+
+    def available(self):
+        return False
+
+    async def observe(self):
+        raise AssertionError("clusterz probed a circuit-open replica")
+
+
+def main() -> None:
+    import jax
+
+    from gofr_tpu.clusterz import build_clusterz
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.hbmz import build_hbmz
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.cluster import (ClusterRegistry, DisaggRouter,
+                                      InProcTransport)
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    container = new_mock_container()
+
+    def build(paged):
+        kwargs = dict(paged_kv=True) if paged else {}
+        return GenerationEngine(cfg, params, max_slots=2, max_len=32,
+                                prompt_buckets=(8,), kv_page=4,
+                                logger=container.logger,
+                                metrics=container.metrics, **kwargs)
+
+    async def run() -> None:
+        prefill_eng, decode_eng = build(False), build(True)
+        container.tpu = decode_eng
+        cluster = ClusterRegistry(logger=container.logger,
+                                  metrics=container.metrics)
+        cluster.register("p0", "prefill", InProcTransport(prefill_eng))
+        cluster.register("d0", "decode", InProcTransport(decode_eng))
+        cluster.register("z9", "decode", _OpenCircuit())
+        router = DisaggRouter(cluster, metrics=container.metrics)
+        await decode_eng.start()
+        try:
+            stream = await router.generate_stream(
+                [1, 2, 3, 4, 5], max_new_tokens=6)
+            tokens = []
+            async for token in stream:
+                tokens.append(token)
+            assert tokens, "disagg request produced no tokens"
+            trace_id = stream.trace_id
+            assert trace_id, "relay stream carries no trace_id"
+
+            # 1. stitched timeline --------------------------------------
+            timeline = await router.trace(trace_id)
+            assert timeline is not None, f"no stitch for {trace_id}"
+            assert timeline["stitched"], timeline
+            names = [p["name"] for p in timeline["phases"]]
+            assert names.count("handoff_gap") == 1, names
+            for want in ("prefill", "kv_transfer", "decode"):
+                assert names.count(want) == 1, names
+            e2e = timeline["e2e_s"]
+            total = sum(p["duration_s"] for p in timeline["phases"])
+            assert e2e > 0, timeline
+            assert abs(total - e2e) <= 0.10 * e2e, \
+                f"phases sum {total:.6f}s vs e2e {e2e:.6f}s (>10% apart)"
+
+            # 2. clusterz rollup ----------------------------------------
+            page = await build_clusterz(cluster, router=router)
+            reps = page["replicas"]
+            assert set(reps) == {"p0", "d0", "z9"}, sorted(reps)
+            assert not reps["p0"]["stale"], reps["p0"]
+            assert not reps["d0"]["stale"], reps["d0"]
+            assert reps["z9"]["stale"], reps["z9"]
+            assert "circuit" in reps["z9"]["stale_reason"], reps["z9"]
+            roles = page["roles"]
+            assert roles["prefill"]["replicas"] == ["p0"], roles
+            assert roles["decode"]["replicas"] == ["d0", "z9"], roles
+            assert roles["decode"]["stale"] == ["z9"], roles
+            assert page["router"]["requests"] == 1, page["router"]
+            assert page["router"]["stitched_traces"] >= 1, page["router"]
+
+            # 3. hbmz attribution ---------------------------------------
+            report = build_hbmz(container)
+            assert report["attributed_bytes"] > 0, report
+            in_use = report.get("device_bytes_in_use")
+            if in_use:
+                residual = report["unattributed_bytes"]
+                assert residual < 0.10 * in_use, \
+                    f"unattributed {residual} >= 10% of in-use {in_use}"
+        finally:
+            await decode_eng.stop()
+
+    asyncio.run(run())
+    print("clusterz smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

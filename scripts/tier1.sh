@@ -19,6 +19,9 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/disagg_smoke.py || exit 1
 # coalescing + batched token shipping on vs off, and staging-slab reuse
 # safety under more in-flight dispatches than the ring depth
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/staging_smoke.py || exit 1
+# fleet observability smoke: clusterz rollup (stale circuit-open replica),
+# cross-replica trace stitching (phase sum within 10% of e2e), hbmz residual
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/clusterz_smoke.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

@@ -1,0 +1,18 @@
+"""GT008 negative fixture: bounded labels and the exemplar channel."""
+
+
+def good_bounded_labels(metrics, replica, slot):
+    metrics.increment_counter("app_requests_total", replica=replica.name)
+    metrics.set_gauge("app_occupancy", 0.5, model=slot.model, cls=slot.cls)
+    metrics.increment_counter("app_dropped_total", reason="expired")
+
+
+def good_exemplar_carries_trace(metrics, span):
+    # exemplars are the sanctioned channel for per-request ids
+    metrics.record_histogram("app_ttft_seconds", 0.1,
+                             exemplar=span.trace_id)
+
+
+def good_pragma(metrics, tenant_id):
+    metrics.increment_counter(  # graftcheck: ignore[GT008]
+        "app_tenant_requests_total", session_id=tenant_id)

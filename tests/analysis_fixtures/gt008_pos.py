@@ -1,0 +1,27 @@
+"""GT008 positive fixture: unbounded values fed into metric labels."""
+
+
+def bad_direct_id(metrics, span):
+    metrics.increment_counter("app_requests_total", trace_id=span.trace_id)
+
+
+def bad_fstring(metrics, record):
+    metrics.set_gauge("app_inflight", 1.0,
+                      request=f"req-{record.request_id}")
+
+
+def bad_str_wrap(metrics, handoff):
+    metrics.increment_counter("app_handoffs_total", handoff=str(handoff))
+
+
+def bad_raw_path(metrics, ctx):
+    metrics.record_histogram("app_latency_seconds", 0.5, path=ctx.path)
+
+
+def bad_label_name(metrics, key):
+    # the label NAME itself promises a per-request value
+    metrics.increment_counter("app_adopted_total", request_id=key)
+
+
+def bad_uuid_call(metrics, uuid):
+    metrics.set_gauge("app_owner", 1.0, owner=uuid.uuid4())

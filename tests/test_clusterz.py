@@ -1,0 +1,265 @@
+"""ISSUE 10 fleet observability: clusterz rollup, cross-replica trace
+stitching, HBM/device-time attribution, and handoff-expiry surfacing.
+
+The cluster pieces run the real disagg path (tiny llama, in-proc
+transports, forced host devices) because the stitched timeline's whole
+point is covering the actual prefill → kv_transfer → decode hop; the
+rollup tests use canned probe transports because staleness handling is
+pure control-plane logic.
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import jax
+import pytest
+
+from gofr_tpu.clusterz import build_clusterz, build_tracez
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.hbmz import build_hbmz
+from gofr_tpu.models import llama
+from gofr_tpu.slo import SLOTracker, STATE_DEGRADED, Watchdog
+from gofr_tpu.tpu.cluster import (ClusterRegistry, DisaggRouter,
+                                  HandoffExpired, HandoffTable,
+                                  InProcTransport)
+from gofr_tpu.tpu.generate import GenerationEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _make_engine(cfg, params, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    engine = GenerationEngine(cfg, params, logger=container.logger,
+                              metrics=container.metrics, **kwargs)
+    return engine, container
+
+
+# -- clusterz rollup ----------------------------------------------------------
+
+class _Probe:
+    """Canned-observation transport for control-plane tests."""
+
+    kind = "inproc"
+
+    def __init__(self, observation=None, circuit_open=False, fail=False):
+        self.observation = observation
+        self.circuit_open = circuit_open
+        self.fail = fail
+        self.probed = 0
+
+    def available(self):
+        return not self.circuit_open
+
+    async def observe(self):
+        self.probed += 1
+        if self.fail:
+            raise RuntimeError("probe blew up")
+        return self.observation
+
+
+def _observation(goodput=40.0, occupancy=0.25):
+    return {
+        "kind": "inproc",
+        "health": "UP",
+        "stats": {"active_slots": 1, "queue_depth": 2,
+                  "kv_pool": {"occupancy": occupancy},
+                  "device_seconds": {"tiny/standard": 1.5}},
+        "slo": {"60s": {"goodput_tokens_per_s": goodput}},
+    }
+
+
+def test_clusterz_marks_stale_and_draining_without_failing_the_page():
+    cluster = ClusterRegistry()
+    open_circuit = _Probe(circuit_open=True)
+    cluster.register("p0", "prefill", _Probe(_observation(goodput=10.0)))
+    cluster.register("d0", "decode", _Probe(_observation(occupancy=0.75)))
+    cluster.register("d1", "decode", open_circuit)
+    cluster.register("d2", "decode", _Probe(fail=True))
+    assert asyncio.run(cluster.drain("d0")) is True      # idle: immediate
+
+    page = asyncio.run(build_clusterz(cluster))
+    reps = page["replicas"]
+
+    assert not reps["p0"]["stale"]
+    assert reps["p0"]["goodput_tokens_per_s"] == 10.0
+
+    assert reps["d0"]["state"] == "DRAINING"
+    assert reps["d0"]["drain"] == {"inflight": 0, "drained": True}
+    assert reps["d0"]["pool_occupancy"] == 0.75
+    assert reps["d0"]["device_seconds"] == {"tiny/standard": 1.5}
+
+    # circuit open: stale, and the transport was never probed
+    assert reps["d1"]["stale"]
+    assert reps["d1"]["stale_reason"] == "circuit open"
+    assert open_circuit.probed == 0
+
+    # probe failure degrades to a stale entry, not a raised page
+    assert reps["d2"]["stale"]
+    assert "probe blew up" in reps["d2"]["stale_reason"]
+
+    roles = page["roles"]
+    assert roles["prefill"]["goodput_tokens_per_s"] == 10.0
+    assert roles["decode"]["draining"] == ["d0"]
+    assert sorted(roles["decode"]["stale"]) == ["d1", "d2"]
+    assert roles["decode"]["max_pool_occupancy"] == 0.75
+
+
+def test_clusterz_includes_router_and_watchdog_sections():
+    cluster = ClusterRegistry()
+    cluster.register("d0", "decode", _Probe(_observation()))
+    router = DisaggRouter(cluster)
+    dog = Watchdog(SLOTracker(), hysteresis=1)
+    page = asyncio.run(build_clusterz(cluster, router=router, watchdog=dog))
+    assert page["router"]["requests"] == 0
+    assert page["router"]["kv_transfer_quantiles"] is None
+    assert page["watchdog"]["state"] == "READY"
+
+
+# -- cross-replica trace stitching --------------------------------------------
+
+async def _stitched_request(cfg, params):
+    prefill_eng, _ = _make_engine(cfg, params, kv_page=4)
+    decode_eng, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4)
+    cluster = ClusterRegistry()
+    cluster.register("p0", "prefill", InProcTransport(prefill_eng))
+    cluster.register("d0", "decode", InProcTransport(decode_eng))
+    router = DisaggRouter(cluster)
+    await decode_eng.start()
+    try:
+        started = time.monotonic()
+        stream = await router.generate_stream([1, 2, 3, 4, 5],
+                                              max_new_tokens=6)
+        tokens = []
+        async for token in stream:
+            tokens.append(token)
+        observed_e2e = time.monotonic() - started
+        timeline = await router.trace(stream.trace_id)
+        device_seconds = decode_eng.stats()["device_seconds"]
+        return tokens, timeline, observed_e2e, device_seconds
+    finally:
+        await decode_eng.stop()
+
+
+def test_trace_stitch_phases_sum_to_e2e(setup):
+    cfg, params = setup
+    tokens, timeline, observed_e2e, device_seconds = asyncio.run(
+        _stitched_request(cfg, params))
+    assert tokens
+
+    assert timeline is not None and timeline["stitched"]
+    names = [p["name"] for p in timeline["phases"]]
+    assert names.count("handoff_gap") == 1          # residual, exactly once
+    for phase in ("prefill", "kv_transfer", "decode"):
+        assert names.count(phase) == 1, names
+
+    e2e = timeline["e2e_s"]
+    assert 0 < e2e <= observed_e2e * 1.10
+    total = sum(p["duration_s"] for p in timeline["phases"])
+    assert abs(total - e2e) <= 0.10 * e2e, (total, e2e)
+
+    # both replicas contributed flight records to the join
+    assert timeline["records"]["prefill"]
+    assert timeline["records"]["decode"]
+    assert timeline["prefill_replica"] == "p0"
+    assert timeline["decode_replica"] == "d0"
+
+    # the decode work was attributed to {model, cls} device-seconds
+    assert device_seconds and all(v > 0 for v in device_seconds.values())
+
+
+def test_trace_unknown_id_returns_none():
+    cluster = ClusterRegistry()
+    router = DisaggRouter(cluster)
+    assert asyncio.run(router.trace("deadbeef")) is None
+
+
+def test_tracez_local_fallback_serves_flight_records():
+    container = new_mock_container()
+
+    class _Recorder:
+        def find(self, trace_id):
+            return [{"trace_id": trace_id, "status": "finished"}]
+
+    container.tpu = SimpleNamespace(recorder=_Recorder())
+    out = asyncio.run(build_tracez(container, "abc123"))
+    assert out["stitched"] is False
+    assert out["records"] == [{"trace_id": "abc123", "status": "finished"}]
+
+
+# -- hbmz attribution ---------------------------------------------------------
+
+def test_hbmz_attribution_accounts_for_in_use_bytes(setup):
+    cfg, params = setup
+    engine, container = _make_engine(cfg, params, paged_kv=True, kv_page=4)
+    container.tpu = engine
+
+    report = build_hbmz(container)
+    assert report["params_bytes"] > 0
+    pool = report["page_pool"]
+    assert pool["pages"]["total"] > 0
+    assert pool["pages"]["free"] <= pool["pages"]["total"]
+    assert report["attributed_bytes"] >= report["params_bytes"]
+
+    in_use = report["device_bytes_in_use"]
+    if in_use:    # CPU backends may not report memory stats
+        assert report["unattributed_bytes"] < 0.10 * in_use
+
+    # the headline gauges track the report
+    assert container.metrics.value("app_tpu_hbm_attributed_bytes") == \
+        report["attributed_bytes"]
+
+
+def test_watchdog_hbm_pressure_degrades_and_none_is_no_signal():
+    dog = Watchdog(SLOTracker(), hysteresis=1,
+                   hbm_fn=lambda: 0.97, max_hbm_occupancy=0.9)
+    dog.evaluate()
+    assert dog.state == STATE_DEGRADED
+    assert any("hbm occupancy" in r for r in dog._last_reasons)
+    assert dog.statusz()["thresholds"]["max_hbm_occupancy"] == 0.9
+
+    quiet = Watchdog(SLOTracker(), hysteresis=1,
+                     hbm_fn=lambda: None, max_hbm_occupancy=0.9)
+    quiet.evaluate()
+    assert quiet.state == "READY"       # unavailable signal ≠ pressure
+
+
+# -- handoff expiry surfacing -------------------------------------------------
+
+def test_expired_handoff_raises_410_and_counts():
+    container = new_mock_container()
+    table = HandoffTable(capacity=4, ttl_s=0.02, logger=container.logger,
+                         metrics=container.metrics)
+    handoff = table.put(b"blob")
+    time.sleep(0.05)
+    with pytest.raises(HandoffExpired) as err:
+        table.get(handoff)
+    assert err.value.status_code == 410
+    assert "expired" in str(err.value)
+    assert container.metrics.value("app_tpu_kv_handoff_expired_total",
+                                   reason="expired") == 1
+    assert table.stats()["expired_total"] == 1
+
+    # capacity eviction is the other drop path, labeled separately
+    tiny = HandoffTable(capacity=1, ttl_s=60.0, metrics=container.metrics)
+    first = tiny.put(b"a")
+    tiny.put(b"b")
+    with pytest.raises(HandoffExpired):
+        tiny.get(first)
+    assert container.metrics.value("app_tpu_kv_handoff_expired_total",
+                                   reason="evicted") == 1
+
+
+def test_unknown_handoff_is_plain_keyerror_not_410():
+    table = HandoffTable()
+    with pytest.raises(KeyError) as err:
+        table.get("never-issued")
+    assert not isinstance(err.value, HandoffExpired)

@@ -201,6 +201,27 @@ def test_gt007_negative_staged_dispatch_is_clean():
     assert report.exit_code == 0
 
 
+# -- GT008 metric-label-cardinality -------------------------------------------
+
+def test_gt008_positive_flags_unbounded_label_values():
+    report = scan("gt008_pos.py", "GT008")
+    got = keys(report)
+    assert "trace_id on app_requests_total" in got
+    assert "request on app_inflight" in got            # f-string composition
+    assert "handoff on app_handoffs_total" in got      # str(...) wrapper
+    assert "path on app_latency_seconds" in got        # raw ctx.path
+    assert "request_id on app_adopted_total" in got    # label name itself
+    assert "owner on app_owner" in got                 # uuid.uuid4() call
+    assert all(f.rule == "GT008" for f in report.new_findings)
+
+
+def test_gt008_negative_bounded_labels_exemplar_and_pragma_are_clean():
+    report = scan("gt008_neg.py", "GT008")
+    assert report.new_findings == []
+    assert report.suppressed == 1      # the pragma'd session_id label
+    assert report.exit_code == 0
+
+
 # -- engine mechanics --------------------------------------------------------
 
 def _write_module(tmp_path, body):
@@ -325,7 +346,8 @@ def test_cli_list_rules_covers_catalog():
     for cls in ALL_RULES:
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
-        {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007"}
+        {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007",
+         "GT008"}
 
 
 def test_lint_metrics_shim_still_works():

@@ -18,14 +18,14 @@ def test_router_exact_and_params():
     router = Router()
     router.add("GET", "/users/{id}/posts/{pid}", _h)
     router.add("GET", "/health", _h)
-    handler, params, _ = router.lookup("GET", "/users/7/posts/9")
+    handler, params, _, _ = router.lookup("GET", "/users/7/posts/9")
     assert handler is not None
     assert params == {"id": "7", "pid": "9"}
-    handler, params, _ = router.lookup("GET", "/health")
+    handler, params, _, _ = router.lookup("GET", "/health")
     assert handler is not None and params == {}
-    handler, _, other = router.lookup("POST", "/health")
+    handler, _, other, _ = router.lookup("POST", "/health")
     assert handler is None and other is True
-    handler, _, other = router.lookup("GET", "/nope")
+    handler, _, other, _ = router.lookup("GET", "/nope")
     assert handler is None and other is False
 
 
@@ -137,10 +137,10 @@ def test_static_files(tmp_path):
     (tmp_path / "secret.txt").write_text("s")
     router = Router()
     router.add_static_files("/static", str(tmp_path))
-    handler, _, _ = router.lookup("GET", "/static/index.html")
+    handler, _, _, _ = router.lookup("GET", "/static/index.html")
     assert handler is not None
-    handler, _, _ = router.lookup("GET", "/static/../secret.txt")
+    handler, _, _, _ = router.lookup("GET", "/static/../secret.txt")
     # traversal outside the dir is refused (resolves within tmp_path here,
     # so check a genuinely outside path)
-    handler_out, _, _ = router.lookup("GET", "/static/../../etc/passwd")
+    handler_out, _, _, _ = router.lookup("GET", "/static/../../etc/passwd")
     assert handler_out is None

@@ -13,6 +13,7 @@ from tests.util import http_request, make_app, parse_sse, run, serving
 
 class _FakeRequest:
     path = "/boom"
+    route = "/boom"   # what dispatch stamps after the route matched
     method = "GET"
 
 
