@@ -142,6 +142,16 @@ REGRESSION_NOTES = {
         "new in r9: mean packed-KV bytes shipped per migrated request — "
         "moves with prompt-length mix and codec (bf16 vs int8+scales), "
         "so pin the workload before reading a delta"),
+    "llama_batch_lane_tok_s_soaked": (
+        "new in r11 (async batch lane): batch tokens the pub/sub lane "
+        "completed during the interactive window / that window's wall "
+        "clock — free throughput off idle ticks; moves with the "
+        "interactive duty cycle, so compare against the same-run "
+        "interactive numbers, not across rounds"),
+    "llama_batch_lane_interactive_ratio": (
+        "new in r11: interactive tok/s with the lane draining jobs / "
+        "interactive-only control — the lane's interference price; the "
+        "acceptance bar is >= 0.95, WFQ class weights are the lever"),
     "resnet50_full_path_vs_device_only": (
         "new in r10 (zero-copy data plane): relay-included classify "
         "rate / device-only rate — the fraction of the hardware the "
@@ -184,6 +194,10 @@ _LEDGER_PATHS = {
                                             "transfer_bytes_per_req"),
     "llama_disagg_hbm_attributed_bytes": ("llama_disagg", "hbmz",
                                           "attributed_bytes"),
+    "llama_batch_lane_tok_s_soaked": ("llama_batch_lane",
+                                      "batch_tok_s_soaked"),
+    "llama_batch_lane_interactive_ratio": ("llama_batch_lane",
+                                           "interactive_goodput_ratio"),
     "resnet50_full_path_vs_device_only": ("full_path_vs_device_only",
                                           "resnet50"),
     "llama7b_full_path_vs_device_only": ("full_path_vs_device_only",
@@ -262,6 +276,7 @@ def main() -> None:
     llama_spec = _llama_speculative_bench(on_tpu)
     llama_disagg = _llama_disagg_bench(on_tpu)
     multi_model = _multi_model_bench(on_tpu)
+    llama_batch_lane = _llama_batch_lane_bench(on_tpu)
     llama7b = _llama7b_int8_bench(on_tpu)
 
     req_per_s = resnet_stats.pop("req_per_s")
@@ -283,6 +298,7 @@ def main() -> None:
         "llama_speculative": llama_spec,
         "llama_disagg": llama_disagg,
         "multi_model": multi_model,
+        "llama_batch_lane": llama_batch_lane,
         "llama7b_int8": llama7b,
     }
     # how much of the hardware the full served path delivers — THE ratio
@@ -1643,6 +1659,161 @@ def _multi_model_bench(on_tpu: bool):
                  "classes through the registry; per-model tok/s shares "
                  "one wall clock (goodput under contention). Compare "
                  "models within this run, not across rounds"),
+    }
+
+
+def _llama_batch_lane_bench(on_tpu: bool):
+    """Async batch lane (docs/tpu/model-serving.md "Batch lane") riding
+    an interactive workload, vs an interactive-only control on the same
+    engine geometry. A queue of pub/sub jobs drips through the WFQ
+    ``batch`` class while waves of deadline-carrying requests run in the
+    foreground; the scenario reports how many batch tokens the lane
+    soaked out of the same wall clock and the interactive goodput ratio
+    against the control — the lane's acceptance bar is that the ratio
+    stays within 5% of 1.0."""
+    import time
+
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
+    from gofr_tpu.models import llama
+    from gofr_tpu.slo import DeadlineExceeded, set_request_deadline
+    from gofr_tpu.tpu.batch_lane import BatchLane
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    if on_tpu:
+        preset, max_len, buckets, slots = "small", 256, (16, 32), 8
+        groups, conc, jobs = 6, 5, 48
+    else:
+        preset, max_len, buckets, slots = "tiny", 64, (8, 16), 6
+        groups, conc, jobs = 4, 4, 24
+    budget = 8
+    think_s = 0.1   # inter-wave gap: the idle ticks batch exists to soak
+    cfg = llama.config(preset)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    prompts = [[(5 * i + j) % 250 + 1 for j in range(buckets[i % 2] - 2)]
+               for i in range(conc)]
+    sheds = {"count": 0}
+
+    def build():
+        container = new_mock_container()
+        engine = GenerationEngine(
+            cfg, params, max_slots=slots, max_len=max_len,
+            prompt_buckets=buckets, steps_per_tick=4,
+            logger=container.logger, metrics=container.metrics)
+        return container, engine
+
+    async def interactive_one(engine, prompt):
+        # a fresh ~2 s budget at submit classifies as `interactive`
+        set_request_deadline(2000.0)
+        try:
+            return await engine.generate(prompt, max_new_tokens=budget)
+        except DeadlineExceeded:
+            sheds["count"] += 1
+            return []
+
+    async def interactive_load(engine):
+        # open-ish loop: waves separated by think time, concurrency held
+        # under the slot count — the duty-cycle shape real interactive
+        # traffic has, and the idle capacity the lane is meant to soak
+        tokens = 0
+        start = time.perf_counter()
+        for _ in range(groups):
+            outs = await asyncio.gather(*[
+                interactive_one(engine, p) for p in prompts])
+            tokens += sum(len(o) for o in outs)
+            await asyncio.sleep(think_s)
+        return tokens, time.perf_counter() - start
+
+    # mixed admission coalesces interactive and batch prompts into one
+    # prefill dispatch, so row counts up to conc+1 (the waves plus the
+    # lane's one in-flight job) all occur — warm every one of them, in
+    # both runs, or the first mixed wave eats a prefill_batch compile
+    # the control never pays
+    warm_counts = tuple(range(1, conc + 2))
+
+    async def control():
+        _, engine = build()
+        await engine.warmup(prompt_counts=warm_counts)
+        await engine.start()
+        try:
+            await asyncio.gather(*[   # warm the serving path end to end
+                engine.generate(p, max_new_tokens=budget) for p in prompts])
+            tokens, elapsed = await interactive_load(engine)
+        finally:
+            await engine.stop()
+        return tokens / elapsed if elapsed else None
+
+    async def mixed():
+        container, engine = build()
+        broker = InMemoryBroker(container.logger, container.metrics)
+        lane = BatchLane(engine, broker, "bench.jobs", max_inflight=1,
+                         default_max_new_tokens=budget,
+                         logger=container.logger,
+                         metrics=container.metrics)
+        await engine.warmup(prompt_counts=warm_counts)
+        await engine.start()
+        try:
+            await asyncio.gather(*[
+                engine.generate(p, max_new_tokens=budget) for p in prompts])
+            await lane.start()
+            # pull two jobs through the lane itself before the timed
+            # window — the batch class's first trip through prefill/
+            # insert is the lane's compile bill, not its steady state
+            for i in range(2):
+                broker.publish("bench.jobs", json.dumps(
+                    {"id": f"warm-{i}",
+                     "prompt_ids": [7 + i] * (buckets[0] - 2),
+                     "max_new_tokens": budget}).encode())
+            deadline = time.perf_counter() + 120
+            while lane.jobs_ok < 2 and time.perf_counter() < deadline:
+                await asyncio.sleep(0.02)
+            for i in range(jobs):   # queue outlives the timed window
+                broker.publish("bench.jobs", json.dumps(
+                    {"id": f"job-{i}",
+                     "prompt_ids": [(3 * i + j) % 250 + 1
+                                    for j in range(buckets[0] - 2)],
+                     "max_new_tokens": budget}).encode())
+            before = lane.jobs_ok
+            tokens, elapsed = await interactive_load(engine)
+            soaked = lane.jobs_ok - before
+            stats = engine.stats().get("classes", {}).get("served", {})
+        finally:
+            await lane.stop()
+            await engine.stop()
+        tok_s = tokens / elapsed if elapsed else None
+        batch_tok_s = soaked * budget / elapsed if elapsed else None
+        return tok_s, batch_tok_s, soaked, stats
+
+    control_tok_s = asyncio.run(control())
+    mixed_tok_s, batch_tok_s, soaked, served = asyncio.run(mixed())
+    ratio = (round(mixed_tok_s / control_tok_s, 3)
+             if control_tok_s and mixed_tok_s else None)
+    return {
+        "preset": preset,
+        "interactive_waves": groups,
+        "interactive_concurrency": conc,
+        "batch_jobs_queued": jobs,
+        "data_plane": {"ingest": "in-mem broker JSON jobs",
+                       "staging": "per-array uploads (coalescer off)"},
+        "interactive_tok_s_control": (round(control_tok_s, 1)
+                                      if control_tok_s else None),
+        "interactive_tok_s_mixed": (round(mixed_tok_s, 1)
+                                    if mixed_tok_s else None),
+        # the acceptance bar: >= 0.95 means batch rode idle ticks, not
+        # the interactive lane's slots
+        "interactive_goodput_ratio": ratio,
+        "batch_tok_s_soaked": (round(batch_tok_s, 1)
+                               if batch_tok_s else None),
+        "batch_jobs_completed_in_window": soaked,
+        "interactive_sheds": sheds["count"],
+        "served_by_class": served,
+        "note": ("same interactive workload with and without the lane "
+                 "draining a batch-job queue behind it; the ratio is the "
+                 "interference price (WFQ should hold it near 1.0), the "
+                 "soak is free throughput. Compare within this run, not "
+                 "across rounds"),
     }
 
 

@@ -63,6 +63,7 @@ def build_app():
                               ModelUnavailable, NoReplicaAvailable,
                               PagePool, kv_wire, parse_peers)
     from gofr_tpu.tpu.cluster import HandoffExpired, HandoffTable
+    from gofr_tpu.tpu.constrain import token_byte_table
     from gofr_tpu.tpu.sched import role_class_weights
 
     app = new_app()
@@ -158,6 +159,13 @@ def build_app():
                 os.environ.get("GENERATE_COALESCE_UPLOADS") == "1"),
             coalesce_stream=(
                 os.environ.get("GENERATE_COALESCE_STREAM") == "1"),
+            # constrained decoding (response_format): token byte table
+            # from THIS tokenizer so grammar masks match what decode()
+            # renders; cache compiled grammars across requests
+            token_table=token_byte_table(tokenizer,
+                                         vocab_size=cfg.vocab_size),
+            grammar_cache_entries=int(os.environ.get(
+                "GENERATE_CONSTRAIN_CACHE", "32")),
             logger=app.logger, metrics=app.container.metrics,
             # flight recorder: queue.wait/prefill/decode child spans per
             # request, engine-step spans with links, /debug/statusz views
@@ -277,25 +285,35 @@ def build_app():
             raise BadRequest(f"missing field: {exc}") from exc
         except (TypeError, ValueError) as exc:
             raise BadRequest(f"bad field value: {exc}") from exc
-        return prompt_ids, max_new, sampling
+        # constrained decoding: {"type": "regex", "pattern": ...} or
+        # {"type": "json_schema", "json_schema": {...}} — grammar compile
+        # errors surface as 400s from the engine's ValueError
+        response_format = data.get("response_format")
+        if response_format is not None and not isinstance(response_format,
+                                                          dict):
+            raise BadRequest("response_format must be an object")
+        return prompt_ids, max_new, sampling, response_format
 
     async def start_stream(eng, data):
         """Validate + admit eagerly so bad requests fail with a 400 before
         any stream bytes are written."""
-        prompt_ids, max_new, sampling = parse_request(data)
+        prompt_ids, max_new, sampling, response_format = parse_request(data)
         try:
             return await eng.generate_stream(
-                prompt_ids, max_new_tokens=max_new, sampling=sampling)
+                prompt_ids, max_new_tokens=max_new, sampling=sampling,
+                response_format=response_format)
         except ValueError as exc:
             raise BadRequest(str(exc)) from exc
 
     async def generate(ctx):
         eng = resolve_engine(ctx)
         await eng.start()  # idempotent; binds to the serving loop
-        prompt_ids, max_new, sampling = parse_request(ctx.bind())
+        prompt_ids, max_new, sampling, response_format = \
+            parse_request(ctx.bind())
         try:
             out = await eng.generate(prompt_ids, max_new_tokens=max_new,
-                                     sampling=sampling)
+                                     sampling=sampling,
+                                     response_format=response_format)
         except ValueError as exc:
             raise BadRequest(str(exc)) from exc
         return {"completion": tokenizer.decode(out),
@@ -455,7 +473,9 @@ def build_app():
     async def disagg_generate(ctx):
         # router front-end: prefill replica → KV handoff → decode replica
         await engine.start()
-        prompt_ids, max_new, sampling = parse_request(ctx.bind())
+        # the disagg relay decodes on a remote replica; constrained
+        # decoding stays a local-lane feature for now
+        prompt_ids, max_new, sampling, _ = parse_request(ctx.bind())
         try:
             out = await router.generate(prompt_ids, max_new,
                                         sampling=sampling)
@@ -476,6 +496,19 @@ def build_app():
             raise BadRequest(str(exc)) from exc
         return {"replica": name, "drained": drained,
                 "cluster": cluster.stats()}
+
+    # async inference lane (ISSUE 11): BATCH_LANE_TOPIC + a PUBSUB_BACKEND
+    # turn this replica into a batch-job consumer. Pre-wired here (rather
+    # than letting App.start build it from config) so jobs can carry text
+    # "prompt" fields and results carry decoded "text" — the lane gets
+    # this app's tokenizer as its encode/decode hooks.
+    if app.config.get("BATCH_LANE_TOPIC") \
+            and app.container.pubsub is not None:
+        from gofr_tpu.tpu.batch_lane import new_batch_lane
+        app.container.batch_lane = new_batch_lane(
+            app.config, app.container.tpu, app.container,
+            encode=lambda text: tokenizer.encode(text)[-512:],
+            decode=tokenizer.decode)
 
     app.post("/generate", generate)
     app.post("/generate/stream", generate_stream)

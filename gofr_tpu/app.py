@@ -423,6 +423,26 @@ class App:
         if self.container.watchdog is not None:
             self.container.watchdog.start()
 
+        # async inference lane (ISSUE 11): BATCH_LANE_TOPIC turns the
+        # pub/sub broker into a generation-job source feeding the WFQ
+        # batch class. An app may pre-wire container.batch_lane itself
+        # (e.g. to attach tokenizer encode/decode hooks) — then this only
+        # starts it; otherwise the lane is built from config here, after
+        # the watchdog exists so backpressure can see DEGRADED.
+        if self.container.batch_lane is None \
+                and self.config.get("BATCH_LANE_TOPIC") \
+                and self.container.pubsub is not None \
+                and self.container.tpu is not None \
+                and (hasattr(self.container.tpu, "generate")
+                     or hasattr(self.container.tpu, "route")):
+            from gofr_tpu.tpu.batch_lane import new_batch_lane
+            self.container.batch_lane = new_batch_lane(
+                self.config, self.container.tpu, self.container)
+        if self.container.batch_lane is not None:
+            if getattr(self.container.batch_lane, "watchdog", None) is None:
+                self.container.batch_lane.watchdog = self.container.watchdog
+            await self.container.batch_lane.start()
+
         self._metrics_server = HTTPServer(
             self._metrics_dispatch, self.metrics_port, logger=self.logger)
         await self._metrics_server.start()
@@ -461,6 +481,11 @@ class App:
             except Exception as exc:
                 self.logger.error("shutdown hook failed: %r", exc)
         self.crontab.stop()
+        if self.container.batch_lane is not None:
+            # stop pulling jobs and let in-flight generations land before
+            # the engines underneath them shut down
+            await self.container.batch_lane.stop(
+                grace_s=self._shutdown_grace)
         if self.container.watchdog is not None:
             await self.container.watchdog.stop()
         for task in self._tasks:

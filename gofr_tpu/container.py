@@ -51,6 +51,7 @@ class Container:
         self.file = None
         self.tpu = None
         self.tpu_batcher = None  # created by App.start when tpu is wired
+        self.batch_lane = None   # pub/sub generation lane (BATCH_LANE_TOPIC)
         # disaggregated serving (ISSUE 8): ClusterRegistry of replica
         # roles, wired by the example/app when CLUSTER_ROLE/CLUSTER_PEERS
         # configure a prefill/decode split; folds into health() below
@@ -135,6 +136,11 @@ class Container:
         metrics.new_counter("app_pubsub_publish_success_count", "publishes ok")
         metrics.new_counter("app_pubsub_subscribe_total_count", "receive attempts")
         metrics.new_counter("app_pubsub_subscribe_success_count", "receives ok")
+        metrics.new_counter(
+            "app_pubsub_consumer_paused_total",
+            "consumer pause transitions per (topic, reason) — backpressure "
+            "from the batch lane (admission_depth|kv_pages|degraded) or an "
+            "explicit fetcher pause")
         # TPU catalog (north star: chip liveness + HBM pressure via metrics)
         metrics.new_histogram("app_tpu_execute", "XLA execute wall time (s)",
                               (0.0005, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1))
@@ -344,6 +350,22 @@ class Container:
             "app_async_task_failures_total",
             "background asyncio tasks that died with an escaped "
             "exception, by task name")
+        # async inference lane (ISSUE 11): pub/sub batch generation jobs
+        # into the WFQ batch class — job outcomes, host-side in-flight
+        # bound, and whether backpressure currently has the lane paused
+        metrics.new_counter(
+            "app_tpu_batch_lane_jobs_total",
+            "batch-lane jobs by outcome (ok|dead_letter) — a dead_letter "
+            "is a committed job whose error envelope went to the "
+            "dead-letter topic")
+        metrics.new_gauge(
+            "app_tpu_batch_lane_inflight",
+            "batch-lane jobs currently generating, per topic (bounded by "
+            "BATCH_LANE_MAX_INFLIGHT)")
+        metrics.new_gauge(
+            "app_tpu_batch_lane_paused",
+            "1 while backpressure has the lane's consumer paused, per "
+            "topic")
 
     # -- outbound services (container.go:150-152) ---------------------------
     def add_http_service(self, name: str, service: Any) -> None:

@@ -26,11 +26,11 @@ def new_pubsub(backend: str, config, logger, metrics, tracer=None) -> PubSub:
         return InMemoryBroker(logger, metrics, tracer=tracer)
     if backend == "MQTT":
         from gofr_tpu.datasource.pubsub.mqtt import MQTTClient
-        return MQTTClient(config, logger, metrics)
+        return MQTTClient(config, logger, metrics, tracer=tracer)
     if backend == "KAFKA":
         from gofr_tpu.datasource.pubsub.kafka import KafkaClient
         return KafkaClient(config, logger, metrics, tracer=tracer)
     if backend == "GOOGLE":
         from gofr_tpu.datasource.pubsub.google import GoogleClient
-        return GoogleClient(config, logger, metrics)
+        return GoogleClient(config, logger, metrics, tracer=tracer)
     raise ValueError(f"unknown PUBSUB_BACKEND {backend!r}")

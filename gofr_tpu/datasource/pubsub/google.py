@@ -22,7 +22,7 @@ class GoogleClientError(Exception):
 
 
 class GoogleClient(PubSub):
-    def __init__(self, config, logger, metrics):
+    def __init__(self, config, logger, metrics, tracer=None):
         try:
             from google.cloud import pubsub_v1
         except ImportError as exc:
@@ -32,6 +32,7 @@ class GoogleClient(PubSub):
             ) from exc
         self.logger = logger
         self.metrics = metrics
+        self.tracer = tracer
         self.project = config.get("GOOGLE_PROJECT_ID")
         if not self.project:
             raise GoogleClientError("GOOGLE_PROJECT_ID is required")
@@ -50,9 +51,29 @@ class GoogleClient(PubSub):
     def publish(self, topic: str, payload: bytes, key: bytes = b"") -> None:
         self.metrics.increment_counter("app_pubsub_publish_total_count",
                                        topic=topic)
-        future = self._publisher.publish(self._topic_path(topic), payload,
-                                         key=key.decode() if key else "")
-        future.result(timeout=30)
+        # Pub/Sub has native message attributes, so the traceparent rides
+        # as one (no byte envelope needed, unlike Kafka/MQTT). The
+        # subscriber callback lifts it back into Message.metadata.
+        attrs = {"key": key.decode() if key else ""}
+        span = None
+        if self.tracer is not None:
+            from gofr_tpu.trace import current_span, format_traceparent
+            if current_span() is not None:
+                span = self.tracer.start_span("pubsub.publish")
+                span.set_attribute("topic", topic)
+                span.set_attribute("backend", "GOOGLE")
+                attrs["traceparent"] = format_traceparent(span)
+        try:
+            future = self._publisher.publish(self._topic_path(topic),
+                                             payload, **attrs)
+            future.result(timeout=30)
+        except Exception:
+            if span is not None:
+                span.set_status("ERROR")
+            raise
+        finally:
+            if span is not None:
+                span.finish()
         self.metrics.increment_counter("app_pubsub_publish_success_count",
                                        topic=topic)
 
@@ -72,7 +93,11 @@ class GoogleClient(PubSub):
                 pass  # already exists
 
             def callback(received):
-                local.put(Message(topic, received.data,
+                attrs = dict(getattr(received, "attributes", None) or {})
+                traceparent = attrs.get("traceparent")
+                metadata = ({"traceparent": traceparent}
+                            if traceparent else None)
+                local.put(Message(topic, received.data, metadata=metadata,
                                   committer=received.ack))
 
             self._pulls[topic] = self._subscriber.subscribe(sub_path,

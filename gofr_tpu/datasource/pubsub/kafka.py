@@ -334,6 +334,9 @@ class _PartitionFetcher(threading.Thread):
     def _stopping(self) -> bool:
         return self.stop_event.is_set() or self.client._closed
 
+    def _paused(self) -> bool:
+        return self.client.is_paused(self.topic)
+
     def _sleep(self, seconds: float) -> None:
         """Interruptible sleep: long connection backoffs must still honor
         stop() promptly (_stop_fetchers joins with a 5 s timeout)."""
@@ -351,6 +354,12 @@ class _PartitionFetcher(threading.Thread):
         conn_backoff = 0.5
         try:
             while not self._stopping():
+                if self._paused():
+                    # backpressure pause (client.pause(topic)): stop
+                    # issuing fetches — the connection stays up, offsets
+                    # stay put, and resume is just the flag clearing
+                    self._sleep(0.1)
+                    continue
                 started = time.monotonic()
                 try:
                     if conn is None:
@@ -490,6 +499,9 @@ class KafkaClient(PubSub):
         self._meta_refreshed_at: Dict[str, float] = {}
         self._queues: Dict[str, "queue.Queue[Optional[Message]]"] = {}
         self._pollers: Dict[str, threading.Thread] = {}
+        # per-topic backpressure flags checked by every partition fetcher
+        # (pause()/resume() below) — set means "stop issuing fetches"
+        self._pause_events: Dict[str, threading.Event] = {}
         self._closed = False
         self._broker(self.bootstrap)  # fail fast if unreachable
         logger.info("kafka connected %s:%d group=%s", *self.bootstrap,
@@ -1048,6 +1060,36 @@ class KafkaClient(PubSub):
                     raise KafkaError(f"fetch error code {error}")
                 out.extend(decode_message_set(message_set, offset))
         return out
+
+    # -- backpressure (ISSUE 11) -------------------------------------------
+    def pause(self, topic: str, reason: str = "backpressure") -> None:
+        """Stop this topic's partition fetchers from issuing fetches —
+        connections stay up, offsets stay put, the consumer group keeps
+        heartbeating (no rebalance). Idempotent; only the unpaused→paused
+        transition is counted in
+        ``app_pubsub_consumer_paused_total{topic,reason}``."""
+        with self._meta_lock:
+            event = self._pause_events.setdefault(topic, threading.Event())
+        if not event.is_set():
+            event.set()
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_pubsub_consumer_paused_total",
+                    topic=topic, reason=reason)
+            self.logger.info("kafka %s: consumer paused (%s)", topic,
+                             reason)
+
+    def resume(self, topic: str) -> None:
+        """Clear a ``pause`` — fetchers pick up from their held offsets
+        on their next loop pass. Idempotent."""
+        event = self._pause_events.get(topic)
+        if event is not None and event.is_set():
+            event.clear()
+            self.logger.info("kafka %s: consumer resumed", topic)
+
+    def is_paused(self, topic: str) -> bool:
+        event = self._pause_events.get(topic)
+        return event is not None and event.is_set()
 
     async def subscribe(self, topic: str) -> Optional[Message]:
         import asyncio

@@ -9,7 +9,16 @@ implements the 3.1.1 wire protocol directly: CONNECT/CONNACK, PUBLISH
 
 Threading model: one reader thread decodes packets and fans PUBLISHes out
 to per-topic thread-safe queues; ``subscribe`` awaits a queue via the
-default executor so the event loop never blocks.
+default executor so the event loop never blocks. A dead reader (broker
+restart, dropped TCP) turns into a reconnect loop with exponential
+backoff that re-subscribes every known topic — subscriptions made before
+the outage survive it.
+
+Trace propagation: MQTT 3.1.1 has no user properties (those are 5.0), so
+when a span is active at publish time the W3C traceparent rides in the
+opt-in byte envelope from ``base.py`` — same carrier as Kafka's
+header-less message-set v1 — and the reader surfaces it as message
+metadata. Untraced publishes keep the wire payload byte-identical.
 """
 
 from __future__ import annotations
@@ -21,7 +30,9 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from gofr_tpu.datasource.pubsub.base import Message, PubSub
+from gofr_tpu.datasource.pubsub.base import (Message, PubSub,
+                                             decode_trace_envelope,
+                                             encode_trace_envelope)
 
 # packet types << 4
 CONNECT, CONNACK = 0x10, 0x20
@@ -98,9 +109,10 @@ def decode_publish(flags: int, body: bytes) -> Tuple[str, bytes, int, int]:
 
 
 class MQTTClient(PubSub):
-    def __init__(self, config, logger, metrics):
+    def __init__(self, config, logger, metrics, tracer=None):
         self.logger = logger
         self.metrics = metrics
+        self.tracer = tracer
         self.host = config.get_or_default("MQTT_HOST", DEFAULT_PUBLIC_BROKER)
         self.port = config.get_int("MQTT_PORT", 1883)
         self.qos = config.get_int("MQTT_QOS", 0)
@@ -115,6 +127,9 @@ class MQTTClient(PubSub):
         self._queues: Dict[str, "queue.Queue[Optional[Message]]"] = {}
         self._subscribed: Dict[str, bool] = {}
         self._connected = threading.Event()
+        # single-reconnector guard: a failed redial can orphan a reader
+        # thread whose own death must not start a second reconnect loop
+        self._reconnecting = threading.Lock()
         self._closed = False
         self._connect()
 
@@ -184,10 +199,52 @@ class MQTTClient(PubSub):
                 body = self._read_exact(length) if length else b""
                 self._on_packet(first, body)
         except Exception as exc:
-            if not self._closed:
-                self.logger.error("mqtt reader died: %r", exc)
-            for q in self._queues.values():
-                q.put(None)
+            if self._closed:
+                return
+            # dead reader ≠ dead client: reconnect with backoff and
+            # re-subscribe every known topic (see _connect). Only a
+            # deliberate close() terminates subscribers with the None
+            # sentinel — a broker restart must be invisible to them.
+            if not self._reconnecting.acquire(blocking=False):
+                return  # another (newer) reader already owns recovery
+            try:
+                self.logger.error("mqtt reader died (reconnecting): %r",
+                                  exc)
+                self._connected.clear()
+                self._close_sock()
+                self._reconnect_loop()
+            finally:
+                self._reconnecting.release()
+
+    def _close_sock(self) -> None:
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _reconnect_loop(self) -> None:
+        """Runs on the dying reader thread: redial until the broker is
+        back (or close()), then hand off to the fresh reader ``_connect``
+        spawns. ``_connect`` re-subscribes ``self._subscribed``, so every
+        topic registered before the outage keeps flowing."""
+        backoff = 0.5
+        while not self._closed:
+            try:
+                self._connect()
+                return
+            except Exception as exc:
+                self._close_sock()  # orphan a half-open dial cleanly
+                self.logger.warn(
+                    "mqtt reconnect to %s:%d failed (retrying in %.1fs): "
+                    "%r", self.host, self.port, backoff, exc)
+                deadline = time.monotonic() + backoff
+                while not self._closed \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                backoff = min(backoff * 2, 30.0)
 
     def _on_packet(self, first: int, body: bytes) -> None:
         packet_type = first & 0xF0
@@ -202,7 +259,10 @@ class MQTTClient(PubSub):
                                                             body)
             if qos == 1:
                 self._send(bytes([PUBACK, 2]) + struct.pack(">H", packet_id))
-            message = Message(topic, payload, committer=lambda: None)
+            traceparent, payload = decode_trace_envelope(payload)
+            metadata = {"traceparent": traceparent} if traceparent else None
+            message = Message(topic, payload, metadata=metadata,
+                              committer=lambda: None)
             self._topic_queue(topic).put(message)
             return
         # SUBACK / PUBACK / PINGRESP need no action for QoS ≤ 1
@@ -221,8 +281,28 @@ class MQTTClient(PubSub):
     def publish(self, topic: str, payload: bytes, key: bytes = b"") -> None:
         self.metrics.increment_counter("app_pubsub_publish_total_count",
                                        topic=topic)
-        packet_id = self._next_packet_id() if self.qos else 0
-        self._send(encode_publish(topic, payload, packet_id, self.qos))
+        # MQTT 3.1.1 has no user properties, so an in-flight trace rides
+        # in the opt-in byte envelope (base.py). Publishes outside a span
+        # keep the wire payload byte-for-byte unchanged.
+        span = None
+        if self.tracer is not None:
+            from gofr_tpu.trace import current_span, format_traceparent
+            if current_span() is not None:
+                span = self.tracer.start_span("pubsub.publish")
+                span.set_attribute("topic", topic)
+                span.set_attribute("backend", "MQTT")
+                payload = encode_trace_envelope(format_traceparent(span),
+                                                payload)
+        try:
+            packet_id = self._next_packet_id() if self.qos else 0
+            self._send(encode_publish(topic, payload, packet_id, self.qos))
+        except Exception:
+            if span is not None:
+                span.set_status("ERROR")
+            raise
+        finally:
+            if span is not None:
+                span.finish()
         self.metrics.increment_counter("app_pubsub_publish_success_count",
                                        topic=topic)
 

@@ -4,6 +4,7 @@ health (north star, BASELINE.json). The identical executor runs on the
 CPU backend in tests — the "miniredis of XLA" strategy (SURVEY.md §4)."""
 
 from gofr_tpu.tpu import kv_wire
+from gofr_tpu.tpu.batch_lane import BatchLane, JobError, new_batch_lane
 from gofr_tpu.tpu.batcher import DynamicBatcher
 from gofr_tpu.tpu.cluster import (ClusterRegistry, DisaggRouter,
                                   HTTPTransport, InProcTransport,
@@ -24,4 +25,5 @@ __all__ = ["DynamicBatcher", "Executor", "FlightRecorder",
            "suggest_ladder", "ModelRegistry", "ModelUnavailable",
            "PagePool", "HBMBudget", "kv_wire", "ClusterRegistry",
            "DisaggRouter", "InProcTransport", "HTTPTransport",
-           "NoReplicaAvailable", "parse_peers"]
+           "NoReplicaAvailable", "parse_peers", "BatchLane", "JobError",
+           "new_batch_lane"]

@@ -59,6 +59,7 @@ import numpy as np
 from gofr_tpu.aio import spawn_logged
 from gofr_tpu.slo import DeadlineExceeded, current_deadline
 from gofr_tpu.tpu.compile_ledger import ShapeStats, suggest_ladder
+from gofr_tpu.tpu.constrain import GrammarWalker
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.tpu.sched import (ClassQueues, DEFAULT_CLASS_WEIGHTS,
                                 deadline_class)
@@ -222,12 +223,13 @@ class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
                  "inflight", "queue", "temperature", "fill", "submitted_at",
                  "deadline", "record", "req_span", "phase_span", "pages",
-                 "nodes", "cls", "spec_proposed", "spec_accepted")
+                 "nodes", "cls", "spec_proposed", "spec_accepted", "grammar")
 
     def __init__(self):
         self.pages: List[int] = []   # paged KV: pool pages this slot owns
         self.nodes: List[Any] = []   # paged KV: pinned prefix-trie nodes
         self.cls = "batch"           # SLO class (tpu.sched.deadline_class)
+        self.grammar = None          # constrained decoding: GrammarWalker
         self.spec_proposed = 0       # speculative decode: draft tokens
         self.spec_accepted = 0       # ... and how many the target kept
         self.future: Optional[asyncio.Future] = None
@@ -293,6 +295,8 @@ class GenerationEngine:
                  class_weights: Optional[Dict[str, float]] = None,
                  coalesce_uploads: bool = False,
                  coalesce_stream: bool = False,
+                 token_table=None,
+                 grammar_cache_entries: int = 32,
                  logger=None, metrics=None, tracer=None, recorder=None,
                  slo=None):
         import jax
@@ -420,6 +424,20 @@ class GenerationEngine:
         self.coalesce_stream = bool(coalesce_stream)
         self._h2d = StagingPool(metrics, depth=1)
         self._coalescer = TransferCoalescer(metrics, pool=self._h2d)
+        # grammar-constrained decoding (ISSUE 11): compiled grammars are
+        # cached per canonical source (regex / JSON schema); per-state
+        # vocab bias rows are cached inside each CompiledGrammar. The
+        # token byte table defaults to the raw-byte identity (ids 0..255
+        # = bytes) matching the repo's byte-level BPE base; pass the
+        # tokenizer's table for merged vocabularies.
+        from gofr_tpu.tpu.constrain import GrammarCache, token_byte_table
+        self._token_table = (list(token_table) if token_table is not None
+                             else token_byte_table(
+                                 vocab_size=cfg.vocab_size))
+        self.grammar_cache = GrammarCache(
+            self._token_table, max_entries=grammar_cache_entries)
+        self._constrained_requests = 0
+        self._constrained_ticks = 0
 
         if mesh is not None:
             from gofr_tpu.ops.quant import quantized_specs
@@ -575,6 +593,16 @@ class GenerationEngine:
         # decode keyed (k, sampled, page-gather width)
         self._insert_paged_fns: Dict[Tuple[int, int, int], Any] = {}
         self._decode_paged_fns: Dict[Tuple[int, bool, int], Any] = {}
+        # constrained-decoding executable families (ISSUE 11): separate
+        # dicts so unconstrained serving keeps its warm keys and dispatch
+        # paths byte-identical. The biased variants take the active mask
+        # as int32 (coalescer-eligible: the per-tick bias slab and the
+        # mask ride ONE TransferCoalescer frame) plus an additive float32
+        # logit-bias matrix applied before argmax/sampling.
+        self._prefill_bias_fns: Dict[Tuple[int, int], Any] = {}
+        self._decode_bias_fns: Dict[Tuple[int, bool, Optional[int]],
+                                    Any] = {}
+        self._decode_paged_bias_fns: Dict[Tuple[int, bool, int], Any] = {}
         # prefix KV reuse (ISSUE 4): page-granular prefix store + the
         # suffix-only prefill/insert executable families keyed
         # (nb, prefix_pages, suffix_bucket). The prefix-pages ladder
@@ -961,6 +989,162 @@ class GenerationEngine:
 
                 fn = jax.jit(decode_k_sampled, donate_argnums=(2, 4, 9))
             self._decode_paged_fns[(k_steps, sampled, pw)] = fn
+        return fn
+
+    def _prefill_bias_fn(self, nb: int, lb: int):
+        """Constrained prefill (ISSUE 11): identical to ``_prefill_fn``
+        plus a per-row additive logit-bias matrix (nb, vocab) applied
+        before the first token is sampled — the grammar's start-state
+        mask steers the first token exactly like every decode step
+        after it."""
+        fn = self._prefill_bias_fns.get((nb, lb))
+        if fn is None:
+            jax, llama, cfg = self._jax, self._llama, self.cfg
+            from gofr_tpu.ops.sampling import sample_batch
+
+            def prefill_batch(params, tokens, lengths, temps, top_ks,
+                              top_ps, seeds, bias):
+                small = llama.init_cache(cfg, nb, lb)
+                logits, small, _ = llama.prefill(params, cfg, tokens, small,
+                                                 lengths=lengths)
+                keys = jax.vmap(jax.random.PRNGKey)(seeds)
+                first, keys = sample_batch(logits + bias, temps, top_ks,
+                                           top_ps, keys)
+                return first, small, keys
+
+            fn = jax.jit(prefill_batch)
+            self._prefill_bias_fns[(nb, lb)] = fn
+        return fn
+
+    def _decode_bias_fn(self, k_steps: int, sampled: bool = False,
+                        window: Optional[int] = None):
+        """Constrained decode tick: ``_decode_fn`` plus an additive
+        (max_slots, vocab) logit bias — the grammar masks, 0 for allowed
+        tokens and NEG_BIAS for the rest — applied before
+        argmax/sampling. The active mask arrives as int32 so mask + bias
+        share one coalesced H2D frame; the executable converts to bool
+        in-program (bit-exact). Constrained slots only ride k=1 ticks
+        (their mask is valid for exactly the next position), so
+        ``k_steps`` is 1 on the serving path."""
+        fn = self._decode_bias_fns.get((k_steps, sampled, window))
+        if fn is None:
+            jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
+                                    self.cfg)
+            from jax import lax
+
+            if not sampled:
+                def decode_k(params, token, cache, cache_len, active_i32,
+                             bias):
+                    active = active_i32.astype(bool)
+
+                    def one(carry, _):
+                        token, cache, cache_len = carry
+                        logits, cache, new_len = llama.decode_step(
+                            params, cfg, token, cache, cache_len,
+                            window=window)
+                        next_token = (logits + bias).argmax(axis=-1).astype(
+                            token.dtype)
+                        new_len = jnp.where(active, new_len, cache_len)
+                        next_token = jnp.where(active, next_token, token)
+                        return (next_token, cache, new_len), next_token
+
+                    (token, cache, cache_len), tokens = lax.scan(
+                        one, (token, cache, cache_len), None, length=k_steps)
+                    return tokens, cache, cache_len
+
+                fn = jax.jit(decode_k, donate_argnums=(2, 3))
+            else:
+                from gofr_tpu.ops.sampling import sample_batch
+
+                def decode_k_sampled(params, token, cache, cache_len,
+                                     active_i32, bias, temps, top_ks,
+                                     top_ps, keys):
+                    active = active_i32.astype(bool)
+
+                    def one(carry, _):
+                        token, cache, cache_len, keys = carry
+                        logits, cache, new_len = llama.decode_step(
+                            params, cfg, token, cache, cache_len,
+                            window=window)
+                        next_token, new_keys = sample_batch(
+                            logits + bias, temps, top_ks, top_ps, keys)
+                        next_token = next_token.astype(token.dtype)
+                        new_len = jnp.where(active, new_len, cache_len)
+                        next_token = jnp.where(active, next_token, token)
+                        keys = jnp.where(active[:, None], new_keys, keys)
+                        return (next_token, cache, new_len, keys), next_token
+
+                    (token, cache, cache_len, keys), tokens = lax.scan(
+                        one, (token, cache, cache_len, keys), None,
+                        length=k_steps)
+                    return tokens, cache, cache_len, keys
+
+                fn = jax.jit(decode_k_sampled, donate_argnums=(2, 3, 9))
+            self._decode_bias_fns[(k_steps, sampled, window)] = fn
+        return fn
+
+    def _decode_paged_bias_fn(self, k_steps: int, sampled: bool = False,
+                              pw: int = 1):
+        """Paged twin of ``_decode_bias_fn`` — same contract as
+        ``_decode_paged_fn`` plus the int32 active mask + additive bias
+        pair. Token-identity with the dense variant under a fixed
+        grammar is asserted by the constrained-decoding tests."""
+        fn = self._decode_paged_bias_fns.get((k_steps, sampled, pw))
+        if fn is None:
+            jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
+                                    self.cfg)
+            from jax import lax
+
+            if not sampled:
+                def decode_k(params, token, pool, table, cache_len,
+                             active_i32, bias):
+                    active = active_i32.astype(bool)
+
+                    def one(carry, _):
+                        token, pool, cache_len = carry
+                        logits, pool2, new_len = llama.decode_step_paged(
+                            params, cfg, token, pool, table, cache_len,
+                            active)
+                        next_token = (logits + bias).argmax(axis=-1).astype(
+                            token.dtype)
+                        new_len = jnp.where(active, new_len, cache_len)
+                        next_token = jnp.where(active, next_token, token)
+                        return (next_token, pool2, new_len), next_token
+
+                    (token, pool, cache_len), tokens = lax.scan(
+                        one, (token, pool, cache_len), None, length=k_steps)
+                    return tokens, pool, cache_len
+
+                fn = jax.jit(decode_k, donate_argnums=(2, 4))
+            else:
+                from gofr_tpu.ops.sampling import sample_batch
+
+                def decode_k_sampled(params, token, pool, table, cache_len,
+                                     active_i32, bias, temps, top_ks,
+                                     top_ps, keys):
+                    active = active_i32.astype(bool)
+
+                    def one(carry, _):
+                        token, pool, cache_len, keys = carry
+                        logits, pool2, new_len = llama.decode_step_paged(
+                            params, cfg, token, pool, table, cache_len,
+                            active)
+                        next_token, new_keys = sample_batch(
+                            logits + bias, temps, top_ks, top_ps, keys)
+                        next_token = next_token.astype(token.dtype)
+                        new_len = jnp.where(active, new_len, cache_len)
+                        next_token = jnp.where(active, next_token, token)
+                        keys = jnp.where(active[:, None], new_keys, keys)
+                        return (next_token, pool2, new_len,
+                                keys), next_token
+
+                    (token, pool, cache_len, keys), tokens = lax.scan(
+                        one, (token, pool, cache_len, keys), None,
+                        length=k_steps)
+                    return tokens, pool, cache_len, keys
+
+                fn = jax.jit(decode_k_sampled, donate_argnums=(2, 4, 10))
+            self._decode_paged_bias_fns[(k_steps, sampled, pw)] = fn
         return fn
 
     def _draft_prefill_fn(self, nb: int, lb: int):
@@ -1441,26 +1625,45 @@ class GenerationEngine:
         # with the flight — checked again at admission time
         return _Flight(link_span, qspan, record, deadline=current_deadline())
 
+    def _compile_grammar(self, response_format, eos_id):
+        """Resolve a request's ``response_format`` through the per-engine
+        grammar cache (raises :class:`~gofr_tpu.tpu.constrain.
+        GrammarError`, a ValueError, on malformed input — callers map it
+        to a 400 before any slot is claimed)."""
+        if response_format is None:
+            return None
+        grammar = self.grammar_cache.get(response_format, eos_id)
+        self._constrained_requests += 1
+        return grammar
+
     async def generate(self, prompt_ids, max_new_tokens: int,
                        eos_id: Optional[int] = None,
-                       sampling: Optional[Sampling] = None) -> List[int]:
+                       sampling: Optional[Sampling] = None,
+                       response_format: Optional[dict] = None) -> List[int]:
         """Generate up to ``max_new_tokens`` ids (stops early on eos_id).
         Concurrent callers share decode steps (continuous batching).
-        ``sampling`` defaults to greedy decoding."""
+        ``sampling`` defaults to greedy decoding. ``response_format``
+        (``{"type": "regex"|"json_schema", ...}``) constrains decoding to
+        a grammar: per-step token masks bias the logits so the output is
+        grammar-valid, and generation finishes as soon as the match is
+        complete."""
         prompt, bucket = self._validate(prompt_ids, max_new_tokens)
+        grammar = self._compile_grammar(response_format, eos_id)
         future = asyncio.get_running_loop().create_future()
         flight = self._new_flight(prompt, max_new_tokens)
         cls = deadline_class(flight.deadline)
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, None,
-                                 time.monotonic(), flight, cls), cls)
+                                 time.monotonic(), flight, cls, grammar),
+                                cls)
         self._set_queue_gauges()
         self._wake.set()
         return await future
 
     async def generate_stream(self, prompt_ids, max_new_tokens: int,
                               eos_id: Optional[int] = None,
-                              sampling: Optional[Sampling] = None):
+                              sampling: Optional[Sampling] = None,
+                              response_format: Optional[dict] = None):
         """Returns a :class:`TokenStream` yielding token ids as they are
         produced. Validation and admission happen eagerly (before the
         first ``__anext__``), so a bad request raises *here* — callers can
@@ -1474,13 +1677,15 @@ class GenerationEngine:
         the request's slot instead of decoding the rest of the budget into
         an unread queue."""
         prompt, bucket = self._validate(prompt_ids, max_new_tokens)
+        grammar = self._compile_grammar(response_format, eos_id)
         queue: asyncio.Queue = asyncio.Queue()
         future = asyncio.get_running_loop().create_future()
         flight = self._new_flight(prompt, max_new_tokens)
         cls = deadline_class(flight.deadline)
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, queue,
-                                 time.monotonic(), flight, cls), cls)
+                                 time.monotonic(), flight, cls, grammar),
+                                cls)
         self._set_queue_gauges()
         self._wake.set()
         return TokenStream(self, queue, future)
@@ -1716,6 +1921,7 @@ class GenerationEngine:
         slot.queue = queue
         slot.temperature = sampling.temperature
         slot.cls = CLASS_MIGRATED
+        slot.grammar = None        # migrated sessions decode unconstrained
         slot.spec_proposed = 0
         slot.spec_accepted = 0
         slot.fill = payload.tokens
@@ -1809,6 +2015,20 @@ class GenerationEngine:
     def active_slots(self) -> int:
         return sum(1 for slot in self._slots if slot.active)
 
+    def admission_depth(self) -> int:
+        """Host admission backlog (WFQ pending + page-deferred overflow)
+        — the batch lane's primary backpressure signal, the live twin of
+        ``app_tpu_admission_queue_depth`` summed over classes."""
+        return self._pending.qsize() + len(self._overflow)
+
+    def kv_free_headroom(self) -> Optional[int]:
+        """Free pool pages above the reserve watermark (paged engines;
+        None on dense). The batch lane pauses its consumer when this
+        runs out rather than piling deferred requests into overflow."""
+        if not self.paged:
+            return None
+        return self._pool.free_pages - self._kv_reserve
+
     def stats(self) -> Dict[str, Any]:
         out = {"model": self.model_name,
                "active_slots": self.active_slots,
@@ -1863,6 +2083,12 @@ class GenerationEngine:
             "served": self._pending.served(),
             "shed": dict(self._shed_by_class),
         }
+        if self._constrained_requests or len(self.grammar_cache):
+            out["constrained"] = {
+                "requests": self._constrained_requests,
+                "ticks": self._constrained_ticks,
+                "grammar_cache": self.grammar_cache.stats(),
+            }
         return out
 
     def data_plane(self) -> Dict[str, Any]:
@@ -2412,12 +2638,12 @@ class GenerationEngine:
         jnp = self._jnp
         fetches: List[Tuple[Any, List[Tuple[int, int, int]],
                             Optional[Span]]] = []
-        by_group: Dict[Tuple[int, int], List[Tuple]] = {}
+        by_group: Dict[Tuple[int, int, bool], List[Tuple]] = {}
         leases: List[Any] = []
         committed = 0      # pages promised to requests admitted this pass
         for ri, request in enumerate(requests):
             prompt, bucket, budget, eos_id, sampling, future, queue, \
-                submitted_at, flight, cls = request
+                submitted_at, flight, cls, grammar = request
             if queue is not None and queue in self._cancelled_queues:
                 # stream consumer vanished before admission: drop it
                 self._cancelled_queues.discard(queue)
@@ -2485,17 +2711,22 @@ class GenerationEngine:
                     self._shed_overflow()
                     break
                 committed += need_max
+            # constrained requests always run a FULL prefill (p_rung 0):
+            # the biased executable family is keyed (nb, bucket) only, so
+            # the suffix-prefill ladder never multiplies by grammar state
             p_rung, sb, page_ids, nodes = (
                 self._prefix_plan(prompt, bucket)
-                if self._prefix is not None else (0, bucket, [], []))
+                if self._prefix is not None and grammar is None
+                else (0, bucket, [], []))
             if not self.paged:
                 # dense: pins last only until this admission pass's
                 # dispatches are ordered; paged slots keep their nodes
                 # pinned for the slot's lifetime (pages ARE the cache)
                 leases.extend(nodes)
-            by_group.setdefault((p_rung, sb), []).append(
+            by_group.setdefault((p_rung, sb, grammar is not None),
+                                []).append(
                 (prompt, budget, eos_id, sampling, future, queue,
-                 submitted_at, flight, page_ids, nodes, cls))
+                 submitted_at, flight, page_ids, nodes, cls, grammar))
         if self._pending.empty() and not self._overflow:
             # no queued request can match a leftover entry any more —
             # bound the set (cancel-after-completion would otherwise leak)
@@ -2506,7 +2737,7 @@ class GenerationEngine:
         # (otherwise later groups' callers would hang unresolved).
         staged: List[Tuple[int, int, int, bool, Any,
                            List[Tuple[int, int, int]]]] = []
-        for (p_rung, bucket), group in sorted(by_group.items()):
+        for (p_rung, bucket, biased), group in sorted(by_group.items()):
             nb = next(x for x in self._n_ladder if x >= len(group))
             plen = p_rung * self._prefix.page if p_rung else 0
             padded = np.zeros((nb, bucket), np.int32)
@@ -2517,6 +2748,10 @@ class GenerationEngine:
             top_ps = np.ones((nb,), np.float32)
             seeds = np.zeros((nb,), np.uint32)
             page_mat = np.zeros((nb, p_rung), np.int32)
+            # constrained group: each row's start-state grammar mask
+            # biases the first token sampled inside the prefill
+            bias_rows = (np.zeros((nb, self.cfg.vocab_size), np.float32)
+                         if biased else None)
             # paged path: fresh page ids per (row, suffix page), row-major,
             # sentinel where the row has no page (padding rows / short
             # suffixes) — the insert scatter drops those
@@ -2537,7 +2772,7 @@ class GenerationEngine:
             claimed: List[Tuple[int, int, int]] = []          # (slot,gen,row)
             for row, (prompt, budget, eos_id, sampling, future, queue,
                       submitted_at, flight, page_ids,
-                      nodes, cls) in enumerate(group):
+                      nodes, cls, grammar) in enumerate(group):
                 slot_idx = self._free.pop()
                 slot = self._slots[slot_idx]
                 slot.future = future
@@ -2552,6 +2787,12 @@ class GenerationEngine:
                 slot.queue = queue
                 slot.temperature = sampling.temperature
                 slot.cls = cls
+                slot.grammar = None
+                if grammar is not None:
+                    # per-request cursor over the shared compiled grammar;
+                    # the start-state bias row steers the prefill's token
+                    slot.grammar = GrammarWalker(grammar)
+                    bias_rows[row, :] = slot.grammar.bias_row()
                 slot.spec_proposed = 0
                 slot.spec_accepted = 0
                 slot.fill = len(prompt)    # device cache_len after insert
@@ -2651,22 +2892,33 @@ class GenerationEngine:
                              lengths=lengths, slots=slots, temps=temps,
                              top_ks=top_ks, top_ps=top_ps, seeds=seeds,
                              page_mat=page_mat, flat_ids=flat_ids,
-                             plen=plen):
+                             plen=plen, bias_rows=bias_rows):
                     # the group's small arrays ship BEFORE the lock (they
                     # never alias the pool) — one coalesced transfer when
-                    # GENERATE_COALESCE_UPLOADS is on
+                    # GENERATE_COALESCE_UPLOADS is on; the grammar bias
+                    # rows (float32) ride the same frame
                     group = dict(padded=padded, lengths=lengths,
                                  slots=slots, temps=temps, top_ks=top_ks,
                                  top_ps=top_ps, seeds=seeds,
                                  flat_ids=flat_ids)
                     if p:
                         group["page_mat"] = page_mat
+                    if bias_rows is not None:
+                        group["bias"] = bias_rows
                     dev = self._upload_group(group)
                     # pool lock: a co-resident engine's donating dispatch
                     # must not interleave between our read of the leaves
                     # handle and the write-back below (tenancy safety)
                     with self._pool.lock:
-                        if p == 0:
+                        if p == 0 and bias_rows is not None:
+                            first, small, keys = self._prefill_bias_fn(
+                                nb, bucket)(
+                                self.params, dev["padded"],
+                                dev["lengths"],
+                                dev["temps"], dev["top_ks"],
+                                dev["top_ps"], dev["seeds"],
+                                dev["bias"])
+                        elif p == 0:
                             first, small, keys = self._prefill_fn(
                                 nb, bucket)(
                                 self.params, dev["padded"],
@@ -2701,7 +2953,9 @@ class GenerationEngine:
                     return first
 
                 warm = ((nb, bucket, plen) in self._insert_paged_fns
-                        and ((nb, bucket) in self._prefill_fns
+                        and ((nb, bucket) in (self._prefill_bias_fns
+                                              if biased
+                                              else self._prefill_fns)
                              if p_rung == 0 else
                              (nb, p_rung, bucket)
                              in self._suffix_prefill_fns))
@@ -2709,16 +2963,27 @@ class GenerationEngine:
                 def dispatch(bucket=bucket, nb=nb, padded=padded,
                              lengths=lengths, slots=slots, temps=temps,
                              top_ks=top_ks, top_ps=top_ps, seeds=seeds,
-                             publish_ids=publish_ids):
-                    dev = self._upload_group(dict(
+                             publish_ids=publish_ids, bias_rows=bias_rows):
+                    group = dict(
                         padded=padded, lengths=lengths, slots=slots,
                         temps=temps, top_ks=top_ks, top_ps=top_ps,
-                        seeds=seeds))
-                    first, small, keys = self._prefill_fn(nb, bucket)(
-                        self.params, dev["padded"],
-                        dev["lengths"],
-                        dev["temps"], dev["top_ks"],
-                        dev["top_ps"], dev["seeds"])
+                        seeds=seeds)
+                    if bias_rows is not None:
+                        group["bias"] = bias_rows
+                    dev = self._upload_group(group)
+                    if bias_rows is not None:
+                        first, small, keys = self._prefill_bias_fn(
+                            nb, bucket)(
+                            self.params, dev["padded"],
+                            dev["lengths"],
+                            dev["temps"], dev["top_ks"],
+                            dev["top_ps"], dev["seeds"], dev["bias"])
+                    else:
+                        first, small, keys = self._prefill_fn(nb, bucket)(
+                            self.params, dev["padded"],
+                            dev["lengths"],
+                            dev["temps"], dev["top_ks"],
+                            dev["top_ps"], dev["seeds"])
                     (self.cache, self.cache_len, self.last_token, self.temps,
                      self.top_ks, self.top_ps, self.sample_keys) = \
                         self._insert_fn(nb, bucket)(
@@ -2734,7 +2999,8 @@ class GenerationEngine:
                         self._prefix.publish(small, publish_ids, nb, bucket)
                     return first
 
-                warm = ((nb, bucket) in self._prefill_fns
+                warm = ((nb, bucket) in (self._prefill_bias_fns if biased
+                                         else self._prefill_fns)
                         and (nb, bucket) in self._insert_fns
                         and (publish_ids is None
                              or self._prefix.publish_ready(nb, bucket)))
@@ -2882,15 +3148,23 @@ class GenerationEngine:
         iteration (pending non-empty AND a free slot exists) — under
         saturation there is nothing to admit, so fused-K ticks continue."""
         jnp = self._jnp
+        # constrained slots only join a tick when no token of theirs is in
+        # flight: their grammar mask is valid for exactly the next
+        # position, so pipelined ticks must not run ahead of the walker
         eligible = [(slot_idx, slot)
                     for slot_idx, slot in enumerate(self._slots)
-                    if slot.active and slot.remaining > slot.inflight]
+                    if slot.active and slot.remaining > slot.inflight
+                    and (slot.grammar is None or slot.inflight == 0)]
         if not eligible:
             return None
+        biased = any(slot.grammar is not None for _, slot in eligible)
         min_wanted = min(slot.remaining - slot.inflight
                          for _, slot in eligible)
         k = 1
-        if self._pending.empty() or not self._free:
+        # a constrained participant pins the tick to k=1 (one mask per
+        # token) and suppresses speculative dispatch (the draft cannot
+        # propose through a grammar)
+        if not biased and (self._pending.empty() or not self._free):
             for rung in self._k_ladder:
                 if rung <= min_wanted:
                     k = rung
@@ -2931,13 +3205,34 @@ class GenerationEngine:
             if slot.record is not None:
                 slot.record.rode_batch(len(eligible))
         window = self._pick_window(fills, k)
-        # keep the mask device-resident: re-upload only when the active set
-        # changed (H2D through a relay costs ~10ms; most ticks are stable)
-        key = active.tobytes()
-        if getattr(self, "_mask_key", None) != key:
-            self._mask_dev = self._h2d.upload(active, jnp.asarray,
-                                              path="mask")
-            self._mask_key = key
+        dev_bias = None
+        if biased:
+            # per-tick grammar masks: every constrained participant's
+            # current-state bias row lands in a fresh (max_slots, vocab)
+            # slab (rows default to 0 — unconstrained participants decode
+            # unbiased; inactive rows are frozen by the mask). Mask +
+            # bias ship as ONE coalesced H2D frame (both 4-byte dtypes),
+            # through the same _upload_group entry point as every other
+            # dispatch — no new per-step device_put path.
+            bias = np.zeros((self.max_slots, self.cfg.vocab_size),
+                            np.float32)
+            active_i32 = np.zeros((self.max_slots,), np.int32)
+            active_i32[active] = 1
+            for slot_idx, slot in eligible:
+                if slot.grammar is not None:
+                    bias[slot_idx, :] = slot.grammar.bias_row()
+            dev_bias = self._upload_group(dict(active=active_i32,
+                                               bias=bias))
+            self._constrained_ticks += 1
+        else:
+            # keep the mask device-resident: re-upload only when the
+            # active set changed (H2D through a relay costs ~10ms; most
+            # ticks are stable)
+            key = active.tobytes()
+            if getattr(self, "_mask_key", None) != key:
+                self._mask_dev = self._h2d.upload(active, jnp.asarray,
+                                                  path="mask")
+                self._mask_key = key
 
         pw = self._pick_page_width(window) if self.paged else 0
 
@@ -2947,7 +3242,21 @@ class GenerationEngine:
                 # engines' donations must not interleave with ours
                 with self._pool.lock:
                     table = self._table_dev(pw)
-                    if sampled:
+                    if biased and sampled:
+                        (tokens_dev, leaves, self.cache_len,
+                         self.sample_keys) = self._decode_paged_bias_fn(
+                            k, sampled=True, pw=pw)(
+                            self.params, self.last_token, self._pool.leaves,
+                            table, self.cache_len, dev_bias["active"],
+                            dev_bias["bias"], self.temps, self.top_ks,
+                            self.top_ps, self.sample_keys)
+                    elif biased:
+                        (tokens_dev, leaves, self.cache_len) = \
+                            self._decode_paged_bias_fn(k, pw=pw)(
+                            self.params, self.last_token, self._pool.leaves,
+                            table, self.cache_len, dev_bias["active"],
+                            dev_bias["bias"])
+                    elif sampled:
                         (tokens_dev, leaves, self.cache_len,
                          self.sample_keys) = self._decode_paged_fn(
                             k, sampled=True, pw=pw)(
@@ -2961,6 +3270,19 @@ class GenerationEngine:
                             self.params, self.last_token, self._pool.leaves,
                             table, self.cache_len, self._mask_dev)
                     self._pool.leaves = leaves
+            elif biased and sampled:
+                (tokens_dev, self.cache, self.cache_len,
+                 self.sample_keys) = self._decode_bias_fn(
+                    k, sampled=True, window=window)(
+                    self.params, self.last_token, self.cache,
+                    self.cache_len, dev_bias["active"], dev_bias["bias"],
+                    self.temps, self.top_ks, self.top_ps,
+                    self.sample_keys)
+            elif biased:
+                tokens_dev, self.cache, self.cache_len = \
+                    self._decode_bias_fn(k, window=window)(
+                    self.params, self.last_token, self.cache,
+                    self.cache_len, dev_bias["active"], dev_bias["bias"])
             elif sampled:
                 (tokens_dev, self.cache, self.cache_len,
                  self.sample_keys) = self._decode_fn(
@@ -2979,8 +3301,14 @@ class GenerationEngine:
         step_span = self._step_span("tpu.engine.step", snapshot,
                                     k=k, window=window or self.max_len,
                                     sampled=sampled, step=self._steps)
-        warm = ((k, sampled, pw) in self._decode_paged_fns if self.paged
-                else (k, sampled, window) in self._decode_fns)
+        if biased:
+            warm = ((k, sampled, pw) in self._decode_paged_bias_fns
+                    if self.paged
+                    else (k, sampled, window) in self._decode_bias_fns)
+        else:
+            warm = ((k, sampled, pw) in self._decode_paged_fns
+                    if self.paged
+                    else (k, sampled, window) in self._decode_fns)
         if warm:
             with self._profile_step("tpu.engine.step"):
                 tokens_dev = dispatch()
@@ -3168,7 +3496,7 @@ class GenerationEngine:
             if request is None:      # unreachable: victim_cls came from
                 return               # the deque itself
             prompt, bucket, budget, eos_id, sampling, future, queue, \
-                submitted_at, flight, cls = request
+                submitted_at, flight, cls, grammar = request
             exc = RuntimeError(
                 f"admission overflow: more than {self._overflow_cap} "
                 f"page-deferred requests; shedding the newest {cls!r} "
@@ -3280,8 +3608,16 @@ class GenerationEngine:
                     chunk.append(token)
                 else:
                     slot.queue.put_nowait(token)
-            if (slot.remaining <= 0
-                    or (slot.eos_id is not None and token == slot.eos_id)):
+            done = (slot.remaining <= 0
+                    or (slot.eos_id is not None and token == slot.eos_id))
+            if slot.grammar is not None and not done:
+                # advance the walker past the emitted token; a completed
+                # match — no grammar-valid continuation left — finishes
+                # the slot exactly like eos (so does a violation, which
+                # only sampling pathologies can produce under the bias)
+                slot.grammar.advance(token)
+                done = slot.grammar.must_stop
+            if done:
                 slot.active = False    # rest of the chunk is discarded
                 self._release_slot_kv(slot_idx, slot)
                 self._free.append(slot_idx)

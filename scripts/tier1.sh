@@ -22,6 +22,9 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/staging_smoke.py || exit 
 # fleet observability smoke: clusterz rollup (stale circuit-open replica),
 # cross-replica trace stitching (phase sum within 10% of e2e), hbmz residual
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/clusterz_smoke.py || exit 1
+# async batch lane smoke: pub/sub jobs -> WFQ batch class -> results,
+# constrained decoding, dead-letter envelope, backpressure pause/resume
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/batch_lane_smoke.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

@@ -1,0 +1,538 @@
+"""Async inference lane (ISSUE 11): pub/sub generation jobs into the WFQ
+``batch`` class, with backpressure, dead-lettering, and trace continuity.
+
+The e2e tests run a real tiny llama engine against the inmem broker —
+jobs in, results out, traceparent stitched producer → consume → result
+publish. Backpressure and broker-hook tests use a stub engine whose
+admission depth the test controls directly, so pause/resume hysteresis
+is asserted without having to wedge a real admission queue.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.datasource.pubsub.inmem import InMemoryBroker
+from gofr_tpu.tpu.batch_lane import (
+    PAUSE_ADMISSION,
+    PAUSE_DEGRADED,
+    BatchLane,
+    new_batch_lane,
+)
+from gofr_tpu.trace import ListExporter, Tracer, extract_traceparent
+
+TOPIC = "gen-jobs"
+
+
+async def _drain_results(broker, topic, count, timeout=30.0):
+    out = []
+    for _ in range(count):
+        message = await asyncio.wait_for(broker.subscribe(topic), timeout)
+        out.append(json.loads(message.value.decode()))
+    return out
+
+
+# -- stub-engine harness -----------------------------------------------------
+
+class StubEngine:
+    """Duck-types the slice of GenerationEngine the lane touches."""
+
+    model_name = "stub"
+
+    def __init__(self):
+        self.depth = 0
+        self.headroom = None
+        self.calls = []
+        self.gate = None  # asyncio.Event → generate blocks until set
+
+    def admission_depth(self):
+        return self.depth
+
+    def kv_free_headroom(self):
+        return self.headroom
+
+    async def generate(self, prompt_ids, max_new_tokens, eos_id=None,
+                       sampling=None, response_format=None):
+        self.calls.append(list(prompt_ids))
+        if self.gate is not None:
+            await self.gate.wait()
+        return [7] * max_new_tokens
+
+
+def _lane(engine, broker, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("poll_s", 0.01)
+    return BatchLane(engine, broker, TOPIC, metrics=container.metrics,
+                     logger=container.logger, **kwargs), container
+
+
+def _job(**fields):
+    job = {"id": "j1", "prompt_ids": [1, 2, 3], "max_new_tokens": 4}
+    job.update(fields)
+    return json.dumps(job).encode()
+
+
+# -- e2e on a real engine ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine_setup():
+    import jax
+
+    from gofr_tpu.models import llama
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _real_engine(cfg, params, container, **kwargs):
+    from gofr_tpu.tpu.generate import GenerationEngine
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    return GenerationEngine(cfg, params, logger=container.logger,
+                            metrics=container.metrics, **kwargs)
+
+
+def test_e2e_jobs_generate_in_batch_class_with_trace_continuity(
+        engine_setup):
+    """Jobs consumed → WFQ batch class → results published inside the
+    consuming trace (producer publish / consume / result publish all share
+    one trace_id, parented in that order)."""
+    from gofr_tpu.tpu.sched import CLASS_BATCH
+
+    cfg, params = engine_setup
+    container = new_mock_container()
+    exporter = ListExporter()
+    tracer = Tracer(exporter=exporter)
+    broker = InMemoryBroker(container.logger, container.metrics,
+                            tracer=tracer)
+    engine = _real_engine(cfg, params, container)
+    lane = BatchLane(engine, broker, TOPIC, poll_s=0.01,
+                     metrics=container.metrics, logger=container.logger,
+                     tracer=tracer)
+
+    async def main():
+        await engine.start()
+        await lane.start()
+        try:
+            broker.publish(TOPIC, _job(id="a"))
+            broker.publish(TOPIC, _job(
+                id="b", prompt_ids=[4, 5], max_new_tokens=3,
+                sampling={"temperature": 0.0}))
+            results = await _drain_results(broker, lane.result_topic, 2)
+            assert not await lane.drain(10.0) or True
+        finally:
+            await lane.stop()
+            await engine.stop()
+        return results
+
+    results = asyncio.run(main())
+    by_id = {r["id"]: r for r in results}
+    assert set(by_id) == {"a", "b"}
+    assert len(by_id["a"]["tokens"]) == 4
+    assert by_id["a"]["usage"] == {"prompt_tokens": 3,
+                                   "completion_tokens": 4,
+                                   "total_tokens": 7}
+    assert by_id["a"]["finish_reason"] in ("stop", "length")
+    assert len(by_id["b"]["tokens"]) == 3
+    # deadline-less jobs land in the WFQ batch class
+    served = lane._route(None).stats()["classes"]["served"]
+    assert served.get(CLASS_BATCH, 0) >= 2
+    assert lane.jobs_ok == 2 and lane.jobs_dead_lettered == 0
+
+    tracer.shutdown()
+    job_pubs = [s for s in exporter.find("pubsub.publish")
+                if s.attributes.get("topic") == TOPIC]
+    result_pubs = [s for s in exporter.find("pubsub.publish")
+                   if s.attributes.get("topic") == lane.result_topic]
+    consumes = exporter.find("pubsub.consume")
+    assert len(job_pubs) == 2 and len(result_pubs) == 2
+    assert len(consumes) == 2
+    by_trace = {s.trace_id: s for s in job_pubs}
+    for consume in consumes:
+        producer = by_trace[consume.trace_id]  # same trace as the job pub
+        assert consume.parent_id == producer.span_id
+        children = [s for s in result_pubs
+                    if s.trace_id == consume.trace_id
+                    and s.parent_id == consume.span_id]
+        assert children, "result publish span must be inside the consume"
+
+
+def test_constrained_job_yields_grammar_valid_result(engine_setup):
+    cfg, params = engine_setup
+    container = new_mock_container()
+    broker = InMemoryBroker(container.logger, container.metrics)
+    engine = _real_engine(cfg, params, container)
+    lane = BatchLane(engine, broker, TOPIC, poll_s=0.01,
+                     metrics=container.metrics, logger=container.logger)
+
+    async def main():
+        await engine.start()
+        await lane.start()
+        try:
+            broker.publish(TOPIC, _job(
+                id="c", max_new_tokens=8,
+                response_format={"type": "regex", "pattern": "(yes|no)!"}))
+            [result] = await _drain_results(broker, lane.result_topic, 1)
+        finally:
+            await lane.stop()
+            await engine.stop()
+        return result
+
+    result = asyncio.run(main())
+    text = bytes(result["tokens"]).decode()  # tiny preset: byte vocab
+    assert text in ("yes!", "no!")
+    assert result["finish_reason"] == "stop"  # grammar completion stops
+    stats = engine.stats()["constrained"]
+    assert stats["requests"] == 1
+    assert stats["grammar_cache"]["entries"] == 1
+
+
+def test_poison_pills_dead_letter_without_killing_subscriber(engine_setup):
+    """Malformed JSON, schema-invalid jobs, and grammar compile errors all
+    land on the dead-letter topic; the lane keeps consuming afterwards."""
+    cfg, params = engine_setup
+    container = new_mock_container()
+    broker = InMemoryBroker(container.logger, container.metrics)
+    engine = _real_engine(cfg, params, container)
+    lane = BatchLane(engine, broker, TOPIC, poll_s=0.01,
+                     metrics=container.metrics, logger=container.logger)
+
+    async def main():
+        await engine.start()
+        await lane.start()
+        try:
+            broker.publish(TOPIC, b"not json at all \xff")
+            broker.publish(TOPIC, _job(id="bad-ids", prompt_ids="nope"))
+            broker.publish(TOPIC, _job(
+                id="bad-grammar",
+                response_format={"type": "regex", "pattern": "("}))
+            broker.publish(TOPIC, _job(id="good"))
+            dead = await _drain_results(broker, lane.dead_letter_topic, 3)
+            results = await _drain_results(broker, lane.result_topic, 1)
+        finally:
+            await lane.stop()
+            await engine.stop()
+        return dead, results
+
+    dead, results = asyncio.run(main())
+    assert results[0]["id"] == "good"
+    kinds = {d["id"]: d["error"]["type"] for d in dead}
+    assert kinds[None] == "JobError"           # unparseable payload
+    assert kinds["bad-ids"] == "JobError"
+    assert kinds["bad-grammar"] == "GrammarError"
+    for d in dead:
+        assert d["error"]["message"]
+        assert "job" in d
+    assert lane.jobs_dead_lettered == 3 and lane.jobs_ok == 1
+    assert container.metrics.value("app_tpu_batch_lane_jobs_total",
+                                   outcome="dead_letter") == 3.0
+    assert container.metrics.value("app_tpu_batch_lane_jobs_total",
+                                   outcome="ok") == 1.0
+
+
+# -- backpressure ------------------------------------------------------------
+
+def test_full_admission_queue_pauses_consumer_and_resumes_after_drain():
+    container = new_mock_container()
+    broker = InMemoryBroker(container.logger, container.metrics)
+    engine = StubEngine()
+    lane = BatchLane(engine, broker, TOPIC, pause_depth=4, resume_depth=1,
+                     poll_s=0.01, metrics=container.metrics,
+                     logger=container.logger)
+
+    async def wait_for(predicate, timeout=10.0):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not predicate():
+            assert asyncio.get_running_loop().time() < deadline, \
+                "condition never became true"
+            await asyncio.sleep(0.01)
+
+    async def main():
+        engine.depth = 10  # over pause_depth before the lane starts
+        await lane.start()
+        try:
+            broker.publish(TOPIC, _job(id="queued"))
+            await wait_for(lambda: lane.paused)
+            # paused: the job stays in the broker, nothing reaches the
+            # engine, and the pause is counted with its reason
+            await asyncio.sleep(0.05)
+            assert engine.calls == []
+            assert container.metrics.value(
+                "app_pubsub_consumer_paused_total",
+                topic=TOPIC, reason=PAUSE_ADMISSION) == 1.0
+            assert container.metrics.value(
+                "app_tpu_batch_lane_paused", topic=TOPIC) == 1.0
+            # hysteresis: dropping below pause_depth but above
+            # resume_depth must NOT resume
+            engine.depth = 3
+            await asyncio.sleep(0.05)
+            assert lane.paused
+            # draining the queue resumes consumption
+            engine.depth = 0
+            await wait_for(lambda: not lane.paused)
+            await wait_for(lambda: engine.calls == [[1, 2, 3]])
+            assert container.metrics.value(
+                "app_tpu_batch_lane_paused", topic=TOPIC) == 0.0
+        finally:
+            await lane.stop()
+
+    asyncio.run(main())
+    assert lane.pauses == 1 and lane.resumes == 1
+
+
+def test_degraded_watchdog_pauses_lane():
+    class FakeWatchdog:
+        state = "DEGRADED"
+
+    container = new_mock_container()
+    broker = InMemoryBroker(container.logger, container.metrics)
+    engine = StubEngine()
+    watchdog = FakeWatchdog()
+    lane = BatchLane(engine, broker, TOPIC, poll_s=0.01, watchdog=watchdog,
+                     metrics=container.metrics, logger=container.logger)
+
+    async def main():
+        await lane.start()
+        try:
+            for _ in range(200):
+                if lane.paused:
+                    break
+                await asyncio.sleep(0.01)
+            assert lane.paused
+            assert container.metrics.value(
+                "app_pubsub_consumer_paused_total",
+                topic=TOPIC, reason=PAUSE_DEGRADED) == 1.0
+            watchdog.state = "READY"
+            broker.publish(TOPIC, _job())
+            for _ in range(200):
+                if engine.calls:
+                    break
+                await asyncio.sleep(0.01)
+            assert engine.calls
+        finally:
+            await lane.stop()
+
+    asyncio.run(main())
+
+
+def test_lane_prefers_broker_pause_hook():
+    """Brokers exposing pause()/resume() (kafka) get called instead of the
+    lane incrementing the pause counter itself — the fetcher owns it."""
+    class PausableBroker(InMemoryBroker):
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self.pause_calls = []
+            self.resume_calls = []
+
+        def pause(self, topic, reason="backpressure"):
+            self.pause_calls.append((topic, reason))
+
+        def resume(self, topic):
+            self.resume_calls.append(topic)
+
+    container = new_mock_container()
+    broker = PausableBroker(container.logger, container.metrics)
+    engine = StubEngine()
+    lane = BatchLane(engine, broker, TOPIC, pause_depth=2, resume_depth=0,
+                     poll_s=0.01, metrics=container.metrics,
+                     logger=container.logger)
+
+    async def main():
+        engine.depth = 5
+        await lane.start()
+        try:
+            for _ in range(200):
+                if lane.paused:
+                    break
+                await asyncio.sleep(0.01)
+            assert lane.paused
+            engine.depth = 0
+            for _ in range(200):
+                if not lane.paused:
+                    break
+                await asyncio.sleep(0.01)
+            assert not lane.paused
+        finally:
+            await lane.stop()
+
+    asyncio.run(main())
+    assert broker.pause_calls == [(TOPIC, PAUSE_ADMISSION)]
+    assert broker.resume_calls == [TOPIC]
+    # the hook owns the counter — the lane must not double count
+    assert container.metrics.value("app_pubsub_consumer_paused_total",
+                                   topic=TOPIC,
+                                   reason=PAUSE_ADMISSION) is None
+
+
+def test_inflight_semaphore_bounds_host_queue():
+    container = new_mock_container()
+    broker = InMemoryBroker(container.logger, container.metrics)
+    engine = StubEngine()
+    lane = BatchLane(engine, broker, TOPIC, max_inflight=2, poll_s=0.01,
+                     metrics=container.metrics, logger=container.logger)
+
+    async def main():
+        engine.gate = asyncio.Event()
+        await lane.start()
+        try:
+            for n in range(6):
+                broker.publish(TOPIC, _job(id=f"j{n}"))
+            for _ in range(100):
+                if len(engine.calls) >= 2:
+                    break
+                await asyncio.sleep(0.01)
+            await asyncio.sleep(0.05)
+            # only max_inflight jobs pulled off the broker; the rest wait
+            assert len(engine.calls) == 2
+            assert lane.stats()["inflight"] == 2
+            engine.gate.set()
+            await _drain_results(broker, lane.result_topic, 6)
+        finally:
+            await lane.stop()
+
+    asyncio.run(main())
+    assert lane.jobs_ok == 6
+
+
+# -- lifecycle / parsing -----------------------------------------------------
+
+def test_drain_waits_for_inflight_jobs():
+    container = new_mock_container()
+    broker = InMemoryBroker(container.logger, container.metrics)
+    engine = StubEngine()
+    lane = BatchLane(engine, broker, TOPIC, poll_s=0.01,
+                     metrics=container.metrics, logger=container.logger)
+
+    async def main():
+        engine.gate = asyncio.Event()
+        await lane.start()
+        broker.publish(TOPIC, _job())
+        for _ in range(100):
+            if engine.calls:
+                break
+            await asyncio.sleep(0.01)
+        assert not await lane.drain(0.05)     # job still gated
+        engine.gate.set()
+        assert await lane.drain(5.0)          # now it lands
+        [result] = await _drain_results(broker, lane.result_topic, 1)
+        assert result["id"] == "j1"
+
+    asyncio.run(main())
+
+
+def test_text_prompt_requires_encode_hook():
+    container = new_mock_container()
+    broker = InMemoryBroker(container.logger, container.metrics)
+    engine = StubEngine()
+    lane_plain = BatchLane(engine, broker, TOPIC, poll_s=0.01,
+                           metrics=container.metrics,
+                           logger=container.logger)
+    lane_tok = BatchLane(engine, broker, "tok-jobs", poll_s=0.01,
+                         encode=lambda text: [ord(c) for c in text],
+                         decode=lambda ids: "".join(chr(i) for i in ids),
+                         metrics=container.metrics,
+                         logger=container.logger)
+
+    async def main():
+        await lane_plain.start()
+        await lane_tok.start()
+        try:
+            broker.publish(TOPIC, json.dumps(
+                {"id": "t", "prompt": "hi", "max_new_tokens": 2}).encode())
+            [dead] = await _drain_results(
+                broker, lane_plain.dead_letter_topic, 1)
+            assert dead["error"]["type"] == "JobError"
+            broker.publish("tok-jobs", json.dumps(
+                {"id": "t2", "prompt": "hi", "max_new_tokens": 2}).encode())
+            [result] = await _drain_results(
+                broker, lane_tok.result_topic, 1)
+            assert result["id"] == "t2"
+            assert result["text"] == chr(7) * 2
+        finally:
+            await lane_plain.stop()
+            await lane_tok.stop()
+
+    asyncio.run(main())
+    assert engine.calls[-1] == [ord("h"), ord("i")]
+
+
+def test_job_result_topic_override():
+    container = new_mock_container()
+    broker = InMemoryBroker(container.logger, container.metrics)
+    engine = StubEngine()
+    lane = BatchLane(engine, broker, TOPIC, poll_s=0.01,
+                     metrics=container.metrics, logger=container.logger)
+
+    async def main():
+        await lane.start()
+        try:
+            broker.publish(TOPIC, _job(result_topic="elsewhere"))
+            [result] = await _drain_results(broker, "elsewhere", 1)
+            assert result["id"] == "j1"
+        finally:
+            await lane.stop()
+
+    asyncio.run(main())
+
+
+def test_new_batch_lane_config_factory():
+    container = new_mock_container()
+    container.pubsub = InMemoryBroker(container.logger, container.metrics)
+    engine = StubEngine()
+
+    assert new_batch_lane(MapConfig({}), engine, container) is None
+
+    config = MapConfig({
+        "BATCH_LANE_TOPIC": "jobs",
+        "BATCH_LANE_RESULT_TOPIC": "done",
+        "BATCH_LANE_MAX_INFLIGHT": "3",
+        "BATCH_LANE_PAUSE_DEPTH": "9",
+        "BATCH_LANE_RESUME_DEPTH": "2",
+    })
+    lane = new_batch_lane(config, engine, container)
+    assert lane is not None
+    assert lane.topic == "jobs"
+    assert lane.result_topic == "done"
+    assert lane.dead_letter_topic == "jobs.dead-letter"
+    assert lane.max_inflight == 3
+    assert lane.pause_depth == 9 and lane.resume_depth == 2
+
+    with pytest.raises(ValueError):
+        BatchLane(engine, container.pubsub, "jobs",
+                  pause_depth=4, resume_depth=4)  # no hysteresis
+
+
+def test_app_lifecycle_builds_and_stops_lane():
+    """BATCH_LANE_TOPIC + broker + engine wired into App → start() spawns
+    the lane (watchdog attached), stop() drains it."""
+    from gofr_tpu.app import App
+
+    container = new_mock_container()
+    container.pubsub = InMemoryBroker(container.logger, container.metrics)
+    container.tpu = StubEngine()
+    config = MapConfig({"BATCH_LANE_TOPIC": "jobs",
+                        "HTTP_PORT": "0", "METRICS_PORT": "0"})
+    container.config = config
+    app = App(config=config, container=container)
+    app.http_port = 0
+    app.metrics_port = 0
+
+    async def main():
+        await app.start()
+        try:
+            lane = container.batch_lane
+            assert lane is not None and lane.topic == "jobs"
+            assert lane.watchdog is container.watchdog
+            container.pubsub.publish("jobs", _job())
+            [result] = await _drain_results(
+                container.pubsub, lane.result_topic, 1)
+            assert result["id"] == "j1"
+        finally:
+            await app.stop()
+        assert not container.batch_lane._jobs
+
+    asyncio.run(main())

@@ -1,0 +1,307 @@
+"""Constrained decoding (ISSUE 11): regex/JSON-schema grammars compiled
+to per-state vocab masks, applied as additive logit bias in decode.
+
+Unit half: the byte-level regex → NFA → lazy DFA pipeline, schema
+lowering, per-state mask caching, and the grammar LRU. Engine half: for
+a fixed grammar, greedy output is grammar-valid and **token-identical**
+across dense vs paged KV and coalesced-uploads on/off — the acceptance
+bar for shipping masks through the coalescer frame.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.tpu.constrain import (
+    CompiledGrammar,
+    GrammarCache,
+    GrammarError,
+    GrammarWalker,
+    canonical_source,
+    schema_to_regex,
+    token_byte_table,
+)
+
+BYTES_256 = token_byte_table(vocab_size=256)
+
+
+def _accepts(pattern, text, table=BYTES_256):
+    grammar = CompiledGrammar(pattern, table, eos_id=None)
+    return grammar.fullmatch(list(text.encode()))
+
+
+# -- regex engine ------------------------------------------------------------
+
+@pytest.mark.parametrize("pattern,ok,bad", [
+    ("abc", ["abc"], ["ab", "abcd", ""]),
+    ("(ab|cd)+", ["ab", "cdab", "ababcd"], ["a", "abc", ""]),
+    ("a{2,4}", ["aa", "aaaa"], ["a", "aaaaa"]),
+    (r"[a-c]\d+\.x?", ["b12.", "c7.x"], ["d1.", "b.", "b12.xx"]),
+    ("[^0-9]+", ["abc", "!?"], ["a1", ""]),
+    (r"-?\d+(\.\d+)?", ["-3", "0.25"], ["-", "1.", ".5"]),
+    ("héllo", ["héllo"], ["hello"]),
+    (r"a.c", ["abc", "a0c"], ["a\nc", "ac"]),
+])
+def test_regex_fullmatch(pattern, ok, bad):
+    for text in ok:
+        assert _accepts(pattern, text), (pattern, text)
+    for text in bad:
+        assert not _accepts(pattern, text), (pattern, text)
+
+
+@pytest.mark.parametrize("pattern", ["(", "a**{", "[z-a]", "(?=x)",
+                                     "a{4,2}", r"\k<name>"])
+def test_malformed_patterns_raise(pattern):
+    with pytest.raises(GrammarError):
+        CompiledGrammar(pattern, BYTES_256, eos_id=None)
+
+
+def test_walker_advance_and_must_stop():
+    grammar = CompiledGrammar("(yes|no)!", BYTES_256, eos_id=None)
+    walker = GrammarWalker(grammar)
+    for byte in b"no!":
+        assert not walker.must_stop
+        assert walker.advance(byte)
+    # the match is complete and nothing can extend it
+    assert walker.accepting and walker.must_stop
+
+    walker = GrammarWalker(grammar)
+    assert not walker.advance(ord("x"))  # dead transition
+    assert walker.violated and walker.must_stop
+
+
+def test_eos_allowed_only_in_accepting_states():
+    table = BYTES_256 + [b""]  # id 256 = eos with empty expansion
+    grammar = CompiledGrammar("ab", table, eos_id=256)
+    walker = GrammarWalker(grammar)
+    assert not bool(grammar.allowed_mask(walker.state)[256])
+    walker.advance(ord("a"))
+    walker.advance(ord("b"))
+    assert bool(grammar.allowed_mask(walker.state)[256])
+
+
+def test_bias_rows_cached_per_state():
+    grammar = CompiledGrammar("(ab)+", BYTES_256, eos_id=None)
+    walker = GrammarWalker(grammar)
+    first = walker.bias_row()
+    builds = grammar.stats()["mask_builds"]
+    walker.advance(ord("a"))
+    walker.advance(ord("b"))  # back to a state equivalent to start
+    again = GrammarWalker(grammar).bias_row()
+    assert again is first  # same ndarray object — cache hit, no rebuild
+    assert grammar.stats()["mask_builds"] == builds
+    assert grammar.stats()["mask_hits"] > 0
+    # the row is the additive bias: 0 where allowed, strongly negative off
+    assert first[ord("a")] == 0.0
+    assert first[ord("b")] < -1e8
+
+
+# -- JSON schema lowering ----------------------------------------------------
+
+def test_schema_to_regex_object_roundtrip():
+    schema = {"type": "object",
+              "properties": {"name": {"type": "string"},
+                             "age": {"type": "integer"},
+                             "ok": {"type": "boolean"}},
+              "required": ["name", "age", "ok"]}
+    pattern = schema_to_regex(schema)
+    grammar = CompiledGrammar(pattern, BYTES_256, eos_id=None)
+    valid = json.dumps({"name": "bo", "age": -3, "ok": True},
+                       separators=(",", ":"))
+    assert grammar.fullmatch(list(valid.encode()))
+    assert not grammar.fullmatch(list(b'{"name":"bo"}'))
+
+
+@pytest.mark.parametrize("schema,ok,bad", [
+    ({"enum": ["a", "b"]}, ['"a"', '"b"'], ['"c"', "a"]),
+    ({"const": 42}, ["42"], ["41", '"42"']),
+    ({"type": "array", "items": {"type": "integer"},
+      "minItems": 1, "maxItems": 2},
+     ["[1]", "[1,2]"], ["[]", "[1,2,3]"]),
+    ({"anyOf": [{"type": "integer"}, {"type": "null"}]},
+     ["7", "null"], ["x", '"7"']),
+])
+def test_schema_variants(schema, ok, bad):
+    grammar = CompiledGrammar(schema_to_regex(schema), BYTES_256,
+                              eos_id=None)
+    for text in ok:
+        assert grammar.fullmatch(list(text.encode())), text
+    for text in bad:
+        assert not grammar.fullmatch(list(text.encode())), text
+
+
+def test_grammar_cache_lru_and_canonical_keys():
+    cache = GrammarCache(BYTES_256, max_entries=2)
+    rf_a = {"type": "regex", "pattern": "a+"}
+    g1 = cache.get(rf_a, eos_id=None)
+    assert cache.get(rf_a, eos_id=None) is g1       # hit
+    # schema key is canonical: key order must not fragment the cache
+    s1 = cache.get({"type": "json_schema",
+                    "json_schema": {"type": "integer"}}, eos_id=None)
+    s2 = cache.get({"type": "json_schema",
+                    "json_schema": {"type": "integer"}}, eos_id=None)
+    assert s1 is s2
+    cache.get({"type": "regex", "pattern": "b+"}, eos_id=None)  # evicts
+    assert len(cache) == 2
+    stats = cache.stats()
+    assert stats["hits"] == 2 and stats["misses"] == 3
+
+    with pytest.raises(GrammarError):
+        canonical_source({"type": "unknown"})
+
+
+def test_token_byte_table_expands_merges():
+    class FakeTok:
+        merges = [(ord("a"), ord("b")), (256, ord("c"))]
+
+    table = token_byte_table(FakeTok())
+    assert len(table) == 258
+    assert table[97] == b"a"
+    assert table[256] == b"ab"
+    assert table[257] == b"abc"
+    # multi-byte tokens walk the DFA through every byte
+    grammar = CompiledGrammar("abc+", table, eos_id=None)
+    assert grammar.fullmatch([257])
+    assert grammar.fullmatch([256, ord("c")])
+    assert not grammar.fullmatch([256])
+
+
+# -- engine integration ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+
+    from gofr_tpu.models import llama
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _make_engine(cfg, params, **kwargs):
+    from gofr_tpu.tpu.generate import GenerationEngine
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    return GenerationEngine(cfg, params, logger=container.logger,
+                            metrics=container.metrics, **kwargs)
+
+
+SCHEMA_RF = {"type": "json_schema",
+             "json_schema": {"type": "object",
+                             "properties": {"ok": {"type": "boolean"}},
+                             "required": ["ok"]}}
+
+
+async def _one(engine, rf, max_new=24):
+    await engine.start()
+    try:
+        return await asyncio.wait_for(engine.generate(
+            [1, 2, 3], max_new_tokens=max_new, response_format=rf), 120.0)
+    finally:
+        await engine.stop()
+
+
+def test_greedy_constrained_token_identical_dense_paged_coalesced(setup):
+    """The acceptance bar: a fixed JSON-schema grammar decodes to the
+    SAME token ids on dense KV, paged KV, and with coalesced uploads —
+    and the ids parse as schema-valid JSON."""
+    cfg, params = setup
+
+    async def main():
+        dense = await _one(_make_engine(cfg, params), SCHEMA_RF)
+        paged = await _one(_make_engine(cfg, params, paged_kv=True,
+                                        kv_page=8, kv_pages=64), SCHEMA_RF)
+        coalesced = await _one(_make_engine(cfg, params,
+                                            coalesce_uploads=True),
+                               SCHEMA_RF)
+        return dense, paged, coalesced
+
+    dense, paged, coalesced = asyncio.run(main())
+    assert dense == paged == coalesced
+    parsed = json.loads(bytes(dense).decode())  # tiny preset: byte vocab
+    assert set(parsed) == {"ok"} and isinstance(parsed["ok"], bool)
+
+
+def test_constrained_does_not_perturb_unconstrained_requests(setup):
+    """A constrained and an unconstrained request sharing the engine: the
+    unconstrained output must equal a solo unconstrained run (separate
+    executable family, no bias leakage)."""
+    cfg, params = setup
+
+    async def main():
+        solo_engine = _make_engine(cfg, params)
+        await solo_engine.start()
+        try:
+            solo = await solo_engine.generate([5, 6, 7], max_new_tokens=6)
+        finally:
+            await solo_engine.stop()
+
+        engine = _make_engine(cfg, params)
+        await engine.start()
+        try:
+            mixed = await asyncio.gather(
+                engine.generate([5, 6, 7], max_new_tokens=6),
+                engine.generate(
+                    [1, 2, 3], max_new_tokens=8,
+                    response_format={"type": "regex",
+                                     "pattern": "(yes|no)!"}))
+        finally:
+            await engine.stop()
+        return solo, mixed
+
+    solo, (unconstrained, constrained) = asyncio.run(main())
+    assert unconstrained == solo
+    assert bytes(constrained).decode() in ("yes!", "no!")
+
+
+def test_grammar_cache_shared_across_requests(setup):
+    cfg, params = setup
+
+    async def main():
+        engine = _make_engine(cfg, params)
+        await engine.start()
+        try:
+            rf = {"type": "regex", "pattern": "(yes|no)!"}
+            first = await engine.generate([1, 2, 3], max_new_tokens=8,
+                                          response_format=rf)
+            second = await engine.generate([1, 2, 3], max_new_tokens=8,
+                                           response_format=rf)
+        finally:
+            await engine.stop()
+        return engine, first, second
+
+    engine, first, second = asyncio.run(main())
+    assert first == second  # greedy + same grammar → bit-reproducible
+    stats = engine.stats()["constrained"]
+    assert stats["requests"] == 2
+    cache = stats["grammar_cache"]
+    assert cache["entries"] == 1 and cache["hits"] == 1
+
+
+def test_bad_response_format_raises_before_admission(setup):
+    cfg, params = setup
+
+    async def main():
+        engine = _make_engine(cfg, params)
+        await engine.start()
+        try:
+            with pytest.raises(GrammarError):
+                await engine.generate(
+                    [1, 2, 3], max_new_tokens=4,
+                    response_format={"type": "regex", "pattern": "("})
+            with pytest.raises(GrammarError):
+                await engine.generate(
+                    [1, 2, 3], max_new_tokens=4,
+                    response_format={"type": "nope"})
+            # the engine still serves after the rejects
+            out = await engine.generate([1, 2, 3], max_new_tokens=3)
+            assert len(out) == 3
+        finally:
+            await engine.stop()
+
+    asyncio.run(main())

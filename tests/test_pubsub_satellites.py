@@ -1,0 +1,232 @@
+"""Pub/sub driver satellites (ISSUE 11): MQTT reconnect re-subscription,
+traceparent continuity on the MQTT and Google drivers, and Kafka's public
+``pause()``/``resume()`` backpressure hooks.
+
+The MQTT tests run over the real 3.1.1 wire against the in-process fake
+broker; Google runs against the sys.modules stub (the driver is absent in
+this image); Kafka against the fake wire broker from test_pubsub_wire.
+"""
+
+import asyncio
+import sys
+import time
+
+import pytest
+
+from gofr_tpu.config import MapConfig
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.trace import ListExporter, Tracer, extract_traceparent
+from tests.test_gated_drivers import (
+    _FakePublisher,
+    _FakeReceived,
+    _FakeSubscriber,
+    _module,
+)
+from tests.test_pubsub_wire import FakeKafkaBroker, FakeMQTTBroker
+
+
+# -- mqtt ---------------------------------------------------------------------
+
+@pytest.fixture()
+def mqtt_setup():
+    from gofr_tpu.datasource.pubsub.mqtt import MQTTClient
+
+    broker = FakeMQTTBroker()
+    container = new_mock_container()
+    exporter = ListExporter()
+    tracer = Tracer(exporter=exporter)
+    client = MQTTClient(MapConfig({"MQTT_HOST": "127.0.0.1",
+                                   "MQTT_PORT": str(broker.port)}),
+                        container.logger, container.metrics, tracer=tracer)
+    yield client, broker, tracer, exporter
+    client.close()
+    broker.stop()
+    tracer.shutdown()
+
+
+def test_mqtt_reconnect_resubscribes_known_topics(mqtt_setup):
+    """Regression: a dead connection must not drop subscriptions. Sever
+    every broker-side socket; the client redials the (still-running)
+    broker and re-subscribes, so a subscriber that was waiting before the
+    outage still receives messages published after it."""
+    client, broker, _, _ = mqtt_setup
+
+    async def scenario():
+        pending = asyncio.ensure_future(client.subscribe("orders"))
+        await asyncio.sleep(0.1)   # let SUBSCRIBE land
+
+        with broker.lock:
+            severed, broker.conns = list(broker.conns), []
+            broker.subscribers = []
+        for conn in severed:
+            conn.close()
+
+        # the dying reader redials and re-subscribes self._subscribed
+        deadline = time.monotonic() + 10.0
+        while not (client._connected.is_set() and broker.subscribers):
+            assert time.monotonic() < deadline, "client never reconnected"
+            await asyncio.sleep(0.05)
+
+        client.publish("orders", b'{"id": 2}')
+        message = await asyncio.wait_for(pending, 10.0)
+        assert message.topic == "orders"
+        assert message.bind() == {"id": 2}
+
+    asyncio.run(scenario())
+    assert client.health_check()["status"] == "UP"
+
+
+def test_mqtt_untraced_publish_keeps_payload_raw(mqtt_setup):
+    client, _, _, exporter = mqtt_setup
+
+    async def scenario():
+        pending = asyncio.ensure_future(client.subscribe("orders"))
+        await asyncio.sleep(0.1)
+        client.publish("orders", b'{"n": 1}')   # no active span
+        return await asyncio.wait_for(pending, 5.0)
+
+    message = asyncio.run(scenario())
+    assert message.value == b'{"n": 1}'
+    assert message.header("traceparent") == ""
+    assert not exporter.find("pubsub.publish")
+
+
+def test_mqtt_traceparent_continuity(mqtt_setup):
+    """Publish inside a span → envelope on the wire → consumer surfaces
+    the traceparent as a message header, same trace end-to-end."""
+    client, _, tracer, exporter = mqtt_setup
+
+    async def scenario():
+        pending = asyncio.ensure_future(client.subscribe("orders"))
+        await asyncio.sleep(0.1)
+        with tracer.start_span("handler") as parent:
+            client.publish("orders", b'{"n": 3}')
+        message = await asyncio.wait_for(pending, 5.0)
+        return parent, message
+
+    parent, message = asyncio.run(scenario())
+    assert message.value == b'{"n": 3}'    # envelope stripped
+    remote = extract_traceparent(message.header("traceparent"))
+    assert remote is not None
+    assert remote["trace_id"] == parent.trace_id
+    tracer.shutdown()
+    publishes = exporter.find("pubsub.publish")
+    assert len(publishes) == 1
+    assert publishes[0].trace_id == parent.trace_id
+    assert publishes[0].parent_id == parent.span_id
+    assert publishes[0].attributes["backend"] == "MQTT"
+    assert remote["span_id"] == publishes[0].span_id
+
+
+# -- google -------------------------------------------------------------------
+
+@pytest.fixture()
+def google_setup(monkeypatch):
+    publisher, subscriber = _FakePublisher(), _FakeSubscriber()
+    pubsub_v1 = _module("google.cloud.pubsub_v1",
+                        PublisherClient=lambda: publisher,
+                        SubscriberClient=lambda: subscriber)
+    cloud = _module("google.cloud", pubsub_v1=pubsub_v1)
+    google = _module("google", cloud=cloud)
+    monkeypatch.setitem(sys.modules, "google", google)
+    monkeypatch.setitem(sys.modules, "google.cloud", cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.pubsub_v1", pubsub_v1)
+
+    from gofr_tpu.datasource.pubsub.google import GoogleClient
+    container = new_mock_container({"GOOGLE_PROJECT_ID": "proj-1",
+                                    "GOOGLE_SUBSCRIPTION_NAME": "svc"})
+    exporter = ListExporter()
+    tracer = Tracer(exporter=exporter)
+    client = GoogleClient(container.config, container.logger,
+                          container.metrics, tracer=tracer)
+    yield client, publisher, subscriber, tracer, exporter
+    client.close()
+    tracer.shutdown()
+
+
+def test_google_traceparent_continuity(google_setup):
+    """Pub/Sub has native attributes, so the traceparent rides as one —
+    the payload itself stays untouched — and the subscriber callback
+    lifts it back into Message.metadata."""
+    client, publisher, subscriber, tracer, exporter = google_setup
+
+    with tracer.start_span("handler") as parent:
+        client.publish("orders", b'{"n": 5}')
+
+    path, payload, attrs = publisher.published[0]
+    assert path.endswith("/topics/orders")
+    assert payload == b'{"n": 5}'          # attribute carrier, no envelope
+    remote = extract_traceparent(attrs["traceparent"])
+    assert remote is not None
+    assert remote["trace_id"] == parent.trace_id
+    tracer.shutdown()
+    publishes = exporter.find("pubsub.publish")
+    assert len(publishes) == 1
+    assert publishes[0].parent_id == parent.span_id
+    assert publishes[0].attributes["backend"] == "GOOGLE"
+    assert remote["span_id"] == publishes[0].span_id
+
+    async def roundtrip():
+        task = asyncio.ensure_future(client.subscribe("orders"))
+        await asyncio.sleep(0.05)   # _ensure_pull registered the callback
+        received = _FakeReceived(b'{"n": 5}')
+        received.attributes = dict(attrs)
+        sub_path = "projects/proj-1/subscriptions/svc-orders"
+        subscriber.callbacks[sub_path](received)
+        return await asyncio.wait_for(task, 10.0)
+
+    message = asyncio.run(roundtrip())
+    assert message.value == b'{"n": 5}'
+    assert message.header("traceparent") == attrs["traceparent"]
+
+
+def test_google_untraced_publish_has_no_traceparent(google_setup):
+    client, publisher, _, _, exporter = google_setup
+    client.publish("orders", b"raw")
+    _, payload, attrs = publisher.published[0]
+    assert payload == b"raw"
+    assert "traceparent" not in attrs
+    assert not exporter.find("pubsub.publish")
+
+
+# -- kafka pause/resume -------------------------------------------------------
+
+def test_kafka_pause_stops_fetch_and_resume_restarts():
+    from gofr_tpu.datasource.pubsub.kafka import KafkaClient
+
+    broker = FakeKafkaBroker()
+    container = new_mock_container()
+    client = KafkaClient(
+        MapConfig({"PUBSUB_BROKER": f"127.0.0.1:{broker.port}",
+                   "CONSUMER_ID": "workers",
+                   "KAFKA_FETCH_MAX_WAIT_MS": "20"}),
+        container.logger, container.metrics)
+    try:
+        async def scenario():
+            client.publish("orders", b"m1")
+            first = await asyncio.wait_for(client.subscribe("orders"), 10.0)
+            assert first.value == b"m1"
+
+            client.pause("orders", reason="admission_depth")
+            assert client.is_paused("orders")
+            client.pause("orders", reason="admission_depth")  # idempotent
+            await asyncio.sleep(0.2)   # drain the in-flight long poll
+            client.publish("orders", b"m2")
+
+            task = asyncio.ensure_future(client.subscribe("orders"))
+            done, _ = await asyncio.wait([task], timeout=0.6)
+            assert not done, "paused consumer still fetched a message"
+
+            client.resume("orders")
+            second = await asyncio.wait_for(task, 10.0)
+            assert second.value == b"m2"
+
+        asyncio.run(scenario())
+        assert not client.is_paused("orders")
+        # only the unpaused→paused transition is counted, once
+        assert container.metrics.value(
+            "app_pubsub_consumer_paused_total",
+            topic="orders", reason="admission_depth") == 1.0
+    finally:
+        client.close()
+        broker.stop()

@@ -39,6 +39,8 @@ class FakeMQTTBroker:
                 conn, _ = self.server.accept()
             except OSError:
                 return
+            with self.lock:
+                self.conns.append(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 

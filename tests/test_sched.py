@@ -120,7 +120,7 @@ def _stub_request(engine, cls, loop):
     flight = engine._new_flight([1, 2, 3], budget=4)
     future = loop.create_future()
     return ([1, 2, 3], 8, 4, None, Sampling(), future, None, 0.0,
-            flight, cls)
+            flight, cls, None)
 
 
 def test_shed_overflow_strictly_within_class(setup):
