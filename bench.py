@@ -142,6 +142,18 @@ REGRESSION_NOTES = {
         "new in r9: mean packed-KV bytes shipped per migrated request — "
         "moves with prompt-length mix and codec (bf16 vs int8+scales), "
         "so pin the workload before reading a delta"),
+    "llama_fleet_affinity_hit_rate": (
+        "new in r12 (fleet control plane): fleet-wide radix-cache hit "
+        "rate with digest-driven affinity routing on a shared-prefix "
+        "workload — compare against prefix_hit_rate_rr from the SAME "
+        "run (the acceptance bar is affinity strictly higher); moves "
+        "with the group/repeat mix, so pin the workload before reading "
+        "a delta"),
+    "llama_fleet_migration_downtime_ms": (
+        "new in r12: one live mid-stream migration, export + kv_wire "
+        "pack/chunk + adopt on the host (no network priced) — tracks "
+        "payload pages and host copy bandwidth, swings with host load "
+        "on the CPU bench container"),
     "llama_batch_lane_tok_s_soaked": (
         "new in r11 (async batch lane): batch tokens the pub/sub lane "
         "completed during the interactive window / that window's wall "
@@ -194,6 +206,10 @@ _LEDGER_PATHS = {
                                             "transfer_bytes_per_req"),
     "llama_disagg_hbm_attributed_bytes": ("llama_disagg", "hbmz",
                                           "attributed_bytes"),
+    "llama_fleet_affinity_hit_rate": ("llama_fleet",
+                                      "prefix_hit_rate_affinity"),
+    "llama_fleet_migration_downtime_ms": ("llama_fleet", "migration",
+                                          "downtime_ms"),
     "llama_batch_lane_tok_s_soaked": ("llama_batch_lane",
                                       "batch_tok_s_soaked"),
     "llama_batch_lane_interactive_ratio": ("llama_batch_lane",
@@ -275,6 +291,7 @@ def main() -> None:
     llama_paged = _llama_paged_kv_bench(on_tpu)
     llama_spec = _llama_speculative_bench(on_tpu)
     llama_disagg = _llama_disagg_bench(on_tpu)
+    llama_fleet = _llama_fleet_bench(on_tpu)
     multi_model = _multi_model_bench(on_tpu)
     llama_batch_lane = _llama_batch_lane_bench(on_tpu)
     llama7b = _llama7b_int8_bench(on_tpu)
@@ -297,6 +314,7 @@ def main() -> None:
         "llama_paged_kv": llama_paged,
         "llama_speculative": llama_spec,
         "llama_disagg": llama_disagg,
+        "llama_fleet": llama_fleet,
         "multi_model": multi_model,
         "llama_batch_lane": llama_batch_lane,
         "llama7b_int8": llama7b,
@@ -1459,6 +1477,178 @@ def _llama_disagg_bench(on_tpu: bool):
                  "network not; disagg TTFT carries the transfer leg. "
                  "Compare monolithic vs disagg within this run, not "
                  "across rounds"),
+    }
+
+
+def _llama_fleet_bench(on_tpu: bool):
+    """Fleet control plane (docs/tpu/model-serving.md "Fleet routing,
+    migration & autoscaling") on a shared-prefix workload: 3 in-proc
+    ``both`` replicas behind a FleetRouter, request groups sharing a
+    multi-page prefix, repeats interleaved round-robin. The AFFINITY arm
+    refreshes the digest index between requests so repeats route back to
+    the replica already holding the prefix; the CONTROL arm never
+    refreshes, so every request rides the registry's least-inflight/RR
+    fallback and repeats scatter across the fleet. The headline is the
+    fleet-wide prefix hit rate (sum of radix-cache hits over lookups
+    across every replica) — affinity must read strictly higher, that is
+    the routing layer's whole job. Also prices one live mid-stream
+    migration (client-visible downtime = export + wire + adopt) and runs
+    the autoscaler twice: once against a hot compile ledger (must hold:
+    ``compile_guard``) and once quiet (scales up a pre-built replica) —
+    no serve-time recompile rides the scale event because the new
+    replica takes no traffic the affinity router still maps elsewhere."""
+    import time
+
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.cluster import ROLE_BOTH, ClusterRegistry, InProcTransport
+    from gofr_tpu.tpu.fleet import Autoscaler, FleetRouter
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    if on_tpu:
+        preset, max_len, buckets, page, slots = (
+            "small", 512, (64, 128), 32, 8)
+        prefix_len, tail_len = 96, 8
+    else:
+        preset, max_len, buckets, page, slots = "tiny", 64, (8, 16), 4, 4
+        prefix_len, tail_len = 12, 2
+    cfg = llama.config(preset)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    budget = 6
+
+    # 4 prefix groups x 3 repeats, interleaved so consecutive requests
+    # never share a prefix — RR placement cannot luck into residency
+    groups = [[(37 * g + j) % 250 + 1 for j in range(prefix_len)]
+              for g in range(4)]
+    workload = [groups[g] + [(11 * g + 7 * r + k) % 250 + 1
+                             for k in range(tail_len)]
+                for r in range(3) for g in range(4)]
+
+    def build():
+        container = new_mock_container()
+        return GenerationEngine(
+            cfg, params, max_slots=slots, max_len=max_len,
+            prompt_buckets=buckets, kv_page=page, paged_kv=True,
+            prefix_cache=True, steps_per_tick=4,
+            logger=container.logger, metrics=container.metrics)
+
+    def hit_rate(engines):
+        hits = total = 0
+        for engine in engines.values():
+            lookups = engine.stats().get("prefix_cache", {}).get(
+                "lookups", {})
+            hits += lookups.get("hit", 0) + lookups.get("partial", 0)
+            total += lookups.get("total", 0)
+        return hits / total if total else 0.0
+
+    async def arm(affinity):
+        engines = {name: build() for name in ("d0", "d1", "d2")}
+        cluster = ClusterRegistry()
+        for name, engine in engines.items():
+            cluster.register(name, ROLE_BOTH, InProcTransport(engine))
+        router = FleetRouter(cluster)
+        for engine in engines.values():
+            await engine.start()
+        try:
+            start = time.perf_counter()
+            total = 0
+            for prompt in workload:
+                out = await asyncio.wait_for(router.generate(
+                    prompt, max_new_tokens=budget), 60.0)
+                total += len(out)
+                if affinity:
+                    await router.refresh()
+            elapsed = time.perf_counter() - start
+            result = {
+                "prefix_hit_rate": round(hit_rate(engines), 4),
+                "tok_s": round(total / elapsed, 1) if elapsed else None,
+                "routing": dict(router.fleet_stats()["routing"]),
+            }
+            if not affinity:
+                return result
+
+            # one live migration, priced end to end: the downtime the
+            # client could observe is export + pack/chunk + adopt
+            session = await router.generate_stream(
+                workload[0], max_new_tokens=16)
+            tokens = [await asyncio.wait_for(session.__anext__(), 60.0)
+                      for _ in range(2)]
+            t0 = time.perf_counter()
+            target = await router.migrate_session(session)
+            downtime_ms = (time.perf_counter() - t0) * 1000.0
+            async for token in session:
+                tokens.append(token)
+            result["migration"] = {
+                "downtime_ms": round(downtime_ms, 2),
+                "tokens_delivered": len(tokens),
+                "target": target,
+                "target_session_adoptions": engines[target].stats()[
+                    "session_adoptions"],
+            }
+
+            # autoscaler: a hot ledger must hold the scale event; a
+            # quiet one admits the pre-built replica. Neither path
+            # touches a serving executable — the guard exists so a
+            # scale step can never pile onto a recompile storm.
+            class _Ledger:
+                def __init__(self, n):
+                    self.n = n
+
+                def serving_compiles(self, window_s):
+                    return self.n
+
+            spare = build()
+
+            async def grow():
+                await spare.start()
+                cluster.register("d3", ROLE_BOTH, InProcTransport(spare))
+
+            events = []
+            for ledger in (_Ledger(1), _Ledger(0)):
+                scaler = Autoscaler(
+                    cluster, scale_up=grow, scale_down=lambda name: None,
+                    router=router, compile_ledger=ledger,
+                    up_after=1, cooldown_s=0.0, max_decode=4,
+                    signals_fn=lambda: {"queue_depth": 99,
+                                        "decode_replicas": 3})
+                events.append((await scaler())["result"])
+            post = await asyncio.wait_for(router.generate(
+                workload[0], max_new_tokens=budget), 60.0)
+            engines["d3"] = spare
+            result["autoscale"] = {
+                "events": events,
+                "post_scale_tokens": len(post),
+            }
+            return result
+        finally:
+            for engine in engines.values():
+                await engine.stop()
+
+    control = asyncio.run(arm(affinity=False))
+    affinity = asyncio.run(arm(affinity=True))
+
+    return {
+        "preset": preset,
+        "requests_per_arm": len(workload),
+        "prefix_pages": prefix_len // page,
+        "prefix_hit_rate_affinity": affinity["prefix_hit_rate"],
+        "prefix_hit_rate_rr": control["prefix_hit_rate"],
+        # the acceptance bar: routing by residency must beat rotation
+        "affinity_beats_rr": (affinity["prefix_hit_rate"]
+                              > control["prefix_hit_rate"]),
+        "decode_tok_s_affinity": affinity["tok_s"],
+        "decode_tok_s_rr": control["tok_s"],
+        "routing_affinity": affinity["routing"],
+        "routing_rr": control["routing"],
+        "migration": affinity["migration"],
+        "autoscale": affinity["autoscale"],
+        "note": ("in-proc fleet: the hit-rate spread is the routing "
+                 "signal, the tok/s spread mostly amortized dispatch — "
+                 "compare arms within this run, not across rounds; "
+                 "migration downtime is export + wire + adopt on the "
+                 "host, no network priced"),
     }
 
 

@@ -27,6 +27,15 @@ front-end that prefills on one replica and decodes on another
 ``POST /disagg/drain`` {"replica": name} drains a replica: routing stops
 immediately, in-flight streams finish, its pool pages come back.
 
+Fleet control plane (ISSUE 12): ``FLEET_ROUTING=1`` upgrades the router
+to prefix-affinity routing off clusterz digests, live session migration
+(``POST /disagg/adopt_session`` is the target half), and
+drain-by-migration; ``FLEET_AUTOSCALE=<cron spec>`` registers the
+decode-pool autoscaler (``FLEET_MIN_DECODE``/``FLEET_MAX_DECODE``/
+``FLEET_QUEUE_HIGH``/``FLEET_QUEUE_LOW``/``FLEET_HBM_HIGH``/
+``FLEET_COOLDOWN_S`` tune it) as a single-flight cron job guarded by the
+cooldown and the compile ledger.
+
 Multi-model serving (ISSUE 7): ``MODELS=big=small>cheap,cheap=tiny,moe=moe``
 registers several named engines behind one ModelRegistry — ``name=preset``
 entries, ``>fallback`` names the model DEGRADED traffic shifts to, the first
@@ -386,12 +395,76 @@ def build_app():
                           metrics=app.container.metrics,
                           tracer=app.container.tracer))
     app.container.cluster = cluster  # role-aware readiness in health()
-    router = DisaggRouter(cluster, logger=app.logger,
-                          metrics=app.container.metrics,
-                          tracer=app.container.tracer)
+    # FLEET_ROUTING=1 upgrades the router to the fleet control plane
+    # (ISSUE 12): prefix-affinity routing off clusterz digests, live
+    # session migration, drain-by-migration
+    fleet_routing = os.environ.get("FLEET_ROUTING", "").strip() in (
+        "1", "true", "on", "yes")
+    if fleet_routing:
+        from gofr_tpu.tpu.fleet import Autoscaler, FleetRouter
+        router = FleetRouter(
+            cluster, logger=app.logger,
+            metrics=app.container.metrics,
+            tracer=app.container.tracer,
+            digest_entries=int(
+                os.environ.get("FLEET_DIGEST_ENTRIES", "512")))
+    else:
+        router = DisaggRouter(cluster, logger=app.logger,
+                              metrics=app.container.metrics,
+                              tracer=app.container.tracer)
     app.container.cluster_router = router  # clusterz/tracez discovery
     app.enable_clusterz()       # fleet rollup over the replica registry
     app.enable_tracez()         # stitched per-trace_id disagg timelines
+
+    if fleet_routing:
+        # keep the affinity index warm: one digest sweep a minute. The
+        # handler bails out when the previous sweep is still probing
+        # (the cron plane overlaps firings by design — graftcheck GT009)
+        refresh_state = {"busy": False}
+
+        async def fleet_refresh(ctx=None):
+            if refresh_state["busy"]:
+                return
+            refresh_state["busy"] = True
+            try:
+                await router.refresh()
+            finally:
+                refresh_state["busy"] = False
+
+        app.add_cron_job("* * * * *", "fleet-refresh", fleet_refresh)
+
+        # FLEET_AUTOSCALE=<cron spec> registers the decode-pool
+        # autoscaler. The example owns no orchestrator, so scale-up is
+        # the operator hook (a log line to replace) and scale-down
+        # drains the victim by migration — sessions move to a peer, the
+        # replica empties in milliseconds
+        autoscale_spec = os.environ.get("FLEET_AUTOSCALE", "").strip()
+        if autoscale_spec:
+            def request_capacity():
+                app.logger.info(
+                    "fleet autoscaler: scale-up requested — wire your "
+                    "orchestrator (spawn a replica, resize the "
+                    "deployment) here")
+
+            autoscaler = Autoscaler(
+                cluster,
+                scale_up=request_capacity,
+                scale_down=lambda name: router.drain(name),
+                router=router,
+                metrics=app.container.metrics, logger=app.logger,
+                container=app.container,
+                compile_ledger=getattr(app.container.tpu, "ledger",
+                                       None),
+                min_decode=int(os.environ.get("FLEET_MIN_DECODE", "1")),
+                max_decode=int(os.environ.get("FLEET_MAX_DECODE", "4")),
+                queue_high=int(os.environ.get("FLEET_QUEUE_HIGH", "8")),
+                queue_low=int(os.environ.get("FLEET_QUEUE_LOW", "1")),
+                hbm_high=float(os.environ.get("FLEET_HBM_HIGH", "0.85")),
+                cooldown_s=float(
+                    os.environ.get("FLEET_COOLDOWN_S", "60")))
+            router.autoscaler = autoscaler  # clusterz fleet rollup
+            app.add_cron_job(autoscale_spec, "fleet-autoscale",
+                             autoscaler)
 
     def parse_sampling(get):
         """Sampling from flat key→value accessors (query params or JSON);
@@ -470,6 +543,33 @@ def build_app():
         tokens = [token async for token in stream]
         return {"tokens": tokens, "model": engine.model_name}
 
+    async def disagg_adopt_session(ctx):
+        # the target half of live migration (ISSUE 12): admit a peer's
+        # exported session snapshot mid-stream — zero re-prefill, the
+        # remaining budget and sampling state ride the query params, the
+        # buffered remainder of the completion is the response
+        await engine.start()
+        blob = ctx.request.body
+        try:
+            remaining = int(ctx.param("remaining") or 0)
+            eos_raw = ctx.param("eos_id")
+            sampling = parse_sampling(
+                lambda key: ctx.param(key) or None)
+            loop = asyncio.get_running_loop()
+            payload = await loop.run_in_executor(None, kv_wire.unpack, blob)
+            stream = await engine.adopt_session(
+                payload, remaining,
+                eos_id=int(eos_raw) if eos_raw else None,
+                sampling=sampling,
+                traceparent=ctx.header("traceparent") or None,
+                transfer_bytes=len(blob))
+        except kv_wire.KVWireError as exc:
+            raise BadRequest(str(exc)) from exc
+        except (TypeError, ValueError) as exc:
+            raise BadRequest(str(exc)) from exc
+        tokens = [token async for token in stream]
+        return {"tokens": tokens, "model": engine.model_name}
+
     async def disagg_generate(ctx):
         # router front-end: prefill replica → KV handoff → decode replica
         await engine.start()
@@ -491,7 +591,14 @@ def build_app():
     async def disagg_drain(ctx):
         name = (ctx.bind() or {}).get("replica", "local")
         try:
-            drained = await cluster.drain(name)
+            # the fleet router drains by migrating live sessions to a
+            # peer first (milliseconds); the base registry drain waits
+            # out the in-flight streams
+            fleet_drain = getattr(router, "drain", None)
+            if fleet_drain is not None:
+                drained = await fleet_drain(name)
+            else:
+                drained = await cluster.drain(name)
         except KeyError as exc:
             raise BadRequest(str(exc)) from exc
         return {"replica": name, "drained": drained,
@@ -518,6 +625,7 @@ def build_app():
     app.post("/disagg/prefill", disagg_prefill)
     app.get("/disagg/fetch", disagg_fetch)
     app.post("/disagg/adopt", disagg_adopt)
+    app.post("/disagg/adopt_session", disagg_adopt_session)
     app.post("/disagg/generate", disagg_generate)
     app.post("/disagg/drain", disagg_drain)
     app.register_grpc_stream("Disagg", "fetch", disagg_fetch_grpc)

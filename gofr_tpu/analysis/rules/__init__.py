@@ -14,6 +14,7 @@ from gofr_tpu.analysis.rules.gt006_kv_transfer import KVTransferSyncRule
 from gofr_tpu.analysis.rules.gt007_host_alloc import HostAllocRule
 from gofr_tpu.analysis.rules.gt008_label_cardinality import \
     LabelCardinalityRule
+from gofr_tpu.analysis.rules.gt009_cron import CronReentrancyRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -24,6 +25,7 @@ ALL_RULES = (
     KVTransferSyncRule,
     HostAllocRule,
     LabelCardinalityRule,
+    CronReentrancyRule,
 )
 
 

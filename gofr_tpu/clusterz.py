@@ -141,6 +141,14 @@ async def build_clusterz(cluster, router=None,
             "kv_transfer_quantiles": router.transfer_quantiles(),
             "stitched_traces": len(router._stitches),
         }
+        # fleet router (tpu/fleet.py): routing split, migrations, and
+        # prefix-index coverage ride the same rollup page
+        fleet_stats = getattr(router, "fleet_stats", None)
+        if fleet_stats is not None:
+            out["fleet"] = fleet_stats()
+            autoscaler = getattr(router, "autoscaler", None)
+            if autoscaler is not None:
+                out["fleet"]["autoscaler"] = autoscaler.status()
     if watchdog is not None:
         out["watchdog"] = watchdog.statusz()
     return out

@@ -336,6 +336,32 @@ class Container:
             "app_tpu_hbm_unattributed_bytes",
             "backend bytes_in_use minus attributed bytes — XLA "
             "temporaries, executables, fragmentation; watch its growth")
+        # fleet control plane catalog (ISSUE 12): prefix-affinity routing,
+        # live decode→decode migration, and the cron autoscaler
+        metrics.new_counter(
+            "app_tpu_fleet_route_total",
+            "decode routing decisions by result (affinity = longest "
+            "resident prefix won, fallback = least-inflight pick)")
+        metrics.new_histogram(
+            "app_tpu_fleet_affinity_pages",
+            "resident-prefix depth (pages) of each affinity-routed "
+            "request — how much prefill the fleet index saved",
+            (1, 2, 4, 8, 16, 32, 64))
+        metrics.new_counter(
+            "app_tpu_fleet_migrations_total",
+            "live decode→decode session migrations by result (ok|error)")
+        metrics.new_histogram(
+            "app_tpu_fleet_migration_seconds",
+            "migration downtime: source export start → target adopt done "
+            "(the client stream's splice gap)",
+            (0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10))
+        metrics.new_counter(
+            "app_tpu_fleet_autoscale_total",
+            "autoscaler decisions by result (up|down|hold|cooldown|"
+            "compile_guard|overlap)")
+        metrics.new_gauge(
+            "app_tpu_fleet_decode_replicas",
+            "READY decode-serving replicas the autoscaler last observed")
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",

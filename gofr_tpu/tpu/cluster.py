@@ -199,10 +199,10 @@ class InProcTransport:
 
     kind = "inproc"
 
-    def __init__(self, engine,
-                 chunk_bytes: int = kv_wire.DEFAULT_CHUNK_BYTES):
+    def __init__(self, engine, chunk_bytes: Optional[int] = None):
         self.engine = engine
-        self.chunk_bytes = int(chunk_bytes)
+        # None resolves the validated KV_WIRE_CHUNK_BYTES knob
+        self.chunk_bytes = kv_wire.resolve_chunk_bytes(chunk_bytes)
 
     def available(self) -> bool:
         return True
@@ -232,6 +232,29 @@ class InProcTransport:
             submitted_at=submitted_at, traceparent=traceparent,
             transfer_s=transfer_s, transfer_bytes=len(blob))
 
+    async def adopt_session(self, blob: bytes, state: Dict[str, Any],
+                            traceparent: Optional[str] = None,
+                            transfer_s: float = 0.0):
+        """Adopt a live decode session snapshot (ISSUE 12): same wire
+        pipeline as ``adopt``, but the engine resumes decoding mid-stream
+        — no first-token re-publish, remaining budget and sampling state
+        come from the exporter's ``state`` dict."""
+        loop = asyncio.get_running_loop()
+        unpack_started = time.perf_counter()
+        payload = await loop.run_in_executor(None, kv_wire.unpack, blob)
+        transfer_s += time.perf_counter() - unpack_started
+        from gofr_tpu.tpu.generate import Sampling
+        sampling = Sampling(
+            temperature=float(state.get("temperature", 0.0)),
+            top_k=int(state.get("top_k", 0)),
+            top_p=float(state.get("top_p", 1.0)))
+        return await self.engine.adopt_session(
+            payload, int(state["remaining"]),
+            eos_id=state.get("eos_id"), sampling=sampling,
+            submitted_at=state.get("submitted_at"),
+            traceparent=traceparent, transfer_s=transfer_s,
+            transfer_bytes=len(blob))
+
     async def observe(self) -> Dict[str, Any]:
         """One clusterz probe: the replica's engine stats + SLO view.
         In-proc, so this is a plain snapshot — no sockets, no awaits on
@@ -245,6 +268,13 @@ class InProcTransport:
         slo = getattr(engine, "slo", None)
         if slo is not None:
             out["slo"] = slo.snapshot()
+        digest_fn = getattr(engine, "prefix_digest", None)
+        if digest_fn is not None:
+            # fleet routing (tpu/fleet.py): compact resident-prefix
+            # digest so the router can steer by cache affinity
+            digest = digest_fn()
+            if digest is not None:
+                out["prefix_digest"] = digest
         return out
 
     async def tracez(self, trace_id: str) -> List[Dict[str, Any]]:
@@ -346,6 +376,33 @@ class HTTPTransport:
         if not response.ok:
             raise RuntimeError(
                 f"decode peer answered {response.status_code}: "
+                f"{response.body[:200]!r}")
+        return _ListStream(response.json().get("tokens", []))
+
+    async def adopt_session(self, blob: bytes, state: Dict[str, Any],
+                            traceparent: Optional[str] = None,
+                            transfer_s: float = 0.0):
+        """Ship a live session snapshot to a remote decode peer. Like
+        ``adopt``, the response is the buffered remainder of the
+        completion relayed token-wise; the peer resumes mid-stream with
+        zero re-prefill."""
+        headers = {"Content-Type": "application/octet-stream"}
+        if traceparent:
+            headers["traceparent"] = traceparent
+        params: Dict[str, Any] = {
+            "remaining": int(state["remaining"]),
+            "temperature": float(state.get("temperature", 0.0)),
+            "top_k": int(state.get("top_k", 0)),
+            "top_p": float(state.get("top_p", 1.0)),
+        }
+        if state.get("eos_id") is not None:
+            params["eos_id"] = int(state["eos_id"])
+        response = await self.service.apost(
+            "/disagg/adopt_session", params=params, body=bytes(blob),
+            headers=headers)
+        if not response.ok:
+            raise RuntimeError(
+                f"migration target answered {response.status_code}: "
                 f"{response.body[:200]!r}")
         return _ListStream(response.json().get("tokens", []))
 
@@ -533,14 +590,19 @@ class ClusterRegistry:
 
     # -- routing ------------------------------------------------------------
     def pick(self, role: str) -> Replica:
-        """Round-robin over READY replicas serving ``role`` (a ``both``
-        replica serves either phase), skipping peers whose circuit is
-        open. Raises :class:`NoReplicaAvailable` when none qualify."""
+        """Least-inflight routing over READY replicas serving ``role``
+        (a ``both`` replica serves either phase), skipping peers whose
+        circuit is open; replicas tied on in-flight count are broken by
+        round-robin so an idle fleet still spreads warm-up traffic
+        instead of hammering rotation order onto one peer. Raises
+        :class:`NoReplicaAvailable` when none qualify."""
         candidates = [r for r in self._replicas.values()
                       if r.state == STATE_READY and r.serves(role)
                       and r.transport.available()]
         if not candidates:
             raise NoReplicaAvailable(role)
+        least = min(r.inflight for r in candidates)
+        candidates = [r for r in candidates if r.inflight == least]
         turn = self._rr.get(role, 0)
         self._rr[role] = turn + 1
         return candidates[turn % len(candidates)]
@@ -681,7 +743,7 @@ class DisaggRouter:
         ``GenerationEngine.generate_stream``."""
         submitted_at = time.monotonic()
         prefiller = self.registry.pick(ROLE_PREFILL)
-        decoder = self.registry.pick(ROLE_DECODE)
+        decoder = self._pick_decode(prompt_ids)
         parent = current_span() if self.tracer is not None else None
         span = (self.tracer.start_span("kv_transfer", parent=parent)
                 if self.tracer is not None else None)
@@ -749,11 +811,25 @@ class DisaggRouter:
             "finished_at": None,      # set when the relay stream closes
         }
         self._remember(entry)
-        return _RelayStream(
+        relay = _RelayStream(
             stream, self.registry, decoder,
             on_finish=lambda: entry.__setitem__(
                 "finished_at", time.monotonic()),
             trace_id=entry["trace_id"])
+        return self._wrap_stream(relay, decoder, stream)
+
+    def _pick_decode(self, prompt_ids) -> Replica:
+        """Decode-target selection hook — the fleet router overrides this
+        with prefix-affinity routing (tpu/fleet.py); the base router
+        load-balances by least inflight."""
+        return self.registry.pick(ROLE_DECODE)
+
+    def _wrap_stream(self, relay: "_RelayStream", decoder: Replica,
+                     stream) -> Any:
+        """Relay post-processing hook — the fleet router wraps the relay
+        in a migratable session so live decode→decode migration can
+        splice a new replica's stream in mid-flight."""
+        return relay
 
     def _remember(self, entry: Dict[str, Any]) -> None:
         self._stitches[entry["trace_id"]] = entry

@@ -223,9 +223,11 @@ class _Slot:
     __slots__ = ("future", "remaining", "eos_id", "tokens", "active", "gen",
                  "inflight", "queue", "temperature", "fill", "submitted_at",
                  "deadline", "record", "req_span", "phase_span", "pages",
-                 "nodes", "cls", "spec_proposed", "spec_accepted", "grammar")
+                 "nodes", "cls", "spec_proposed", "spec_accepted", "grammar",
+                 "migrating")
 
     def __init__(self):
+        self.migrating = False  # quiescing for export: joins no new tick
         self.pages: List[int] = []   # paged KV: pool pages this slot owns
         self.nodes: List[Any] = []   # paged KV: pinned prefix-trie nodes
         self.cls = "batch"           # SLO class (tpu.sched.deadline_class)
@@ -622,6 +624,10 @@ class GenerationEngine:
         self._adopt_fns: Dict[int, Any] = {}
         self._kv_exports = 0
         self._kv_adoptions = 0
+        # live decode→decode migration (ISSUE 12): sessions shipped out
+        # mid-stream and sessions resumed from a peer's snapshot
+        self._session_exports = 0
+        self._session_adoptions = 0
         # device-time attribution (ISSUE 10): dispatch→publish wall time
         # split evenly across a step's participating slots and charged to
         # {model, slo class}. Attribution, not utilization — pipelined
@@ -1802,7 +1808,8 @@ class GenerationEngine:
                        submitted_at: Optional[float] = None,
                        traceparent: Optional[str] = None,
                        transfer_s: float = 0.0,
-                       transfer_bytes: int = 0) -> TokenStream:
+                       transfer_bytes: int = 0,
+                       resume: bool = False) -> TokenStream:
         """Decode-replica half of the handoff: admit an exported
         :class:`~gofr_tpu.tpu.kv_wire.KVPayload` straight into the page
         pool as page-table entries and start decoding from its first
@@ -1839,6 +1846,13 @@ class GenerationEngine:
             raise kv_wire.KVWireError(
                 f"payload codec {payload.codec} does not match the pool "
                 "storage format (no transcoding on adopt)")
+        # a SESSION snapshot's first_token was already delivered to the
+        # client by the exporting replica — publishing it again would
+        # duplicate a token; only adopt_session may admit one
+        if bool(payload.flags & kv_wire.FLAG_SESSION) != resume:
+            raise kv_wire.KVWireError(
+                "session-flagged payloads must be adopted via "
+                "adopt_session (and prefill payloads via adopt_kv)")
         if max_new_tokens < 1:
             raise ValueError("adopt_kv needs max_new_tokens >= 1")
         if payload.tokens + max_new_tokens > self.max_len:
@@ -1915,9 +1929,12 @@ class GenerationEngine:
         slot.eos_id = eos_id
         slot.tokens = []
         slot.active = False
+        slot.migrating = False
         slot.gen += 1
         gen = slot.gen
-        slot.inflight = 1          # the shipped first token
+        # a prefill handoff ships one already-sampled token to publish;
+        # a resumed session's last token was delivered by the exporter
+        slot.inflight = 0 if resume else 1
         slot.queue = queue
         slot.temperature = sampling.temperature
         slot.cls = CLASS_MIGRATED
@@ -1975,17 +1992,187 @@ class GenerationEngine:
             raise
         slot.active = True
         self._kv_adoptions += 1
+        if resume:
+            self._session_adoptions += 1
         if self.metrics is not None:
             self.metrics.increment_counter(
                 "app_tpu_kv_adoptions_total", model=self.model_name)
         self._wake.set()
-        # publish the shipped first token through the normal path: TTFT,
-        # eos/budget bookkeeping, and immediate finish all behave exactly
-        # as if a local prefill fetch had just landed
-        self._push_tokens(slot_idx, gen, [payload.first_token])
+        if not resume:
+            # publish the shipped first token through the normal path:
+            # TTFT, eos/budget bookkeeping, and immediate finish all
+            # behave exactly as if a local prefill fetch had just landed.
+            # A resumed session publishes nothing here — its next token
+            # comes out of this engine's first decode tick, conditioned
+            # on the shipped last_token/sample_key.
+            self._push_tokens(slot_idx, gen, [payload.first_token])
         if span is not None:
             span.finish()
         return TokenStream(self, queue, future)
+
+    async def adopt_session(self, payload, remaining: int,
+                            eos_id: Optional[int] = None,
+                            sampling: Optional[Sampling] = None,
+                            submitted_at: Optional[float] = None,
+                            traceparent: Optional[str] = None,
+                            transfer_s: float = 0.0,
+                            transfer_bytes: int = 0) -> TokenStream:
+        """Resume a live decode session exported by a peer's
+        :meth:`export_session` (ISSUE 12). The payload's pages carry the
+        session's whole committed KV (prompt + every token decoded so
+        far), ``first_token`` is the last token the exporter committed,
+        and ``sample_key`` its advanced PRNG state — decode continues
+        token-identically with zero re-prefill, exactly like a prefill
+        handoff but mid-stream. The returned stream yields only tokens
+        generated *after* the hop; the fleet relay splices it onto the
+        client's stream."""
+        return await self.adopt_kv(
+            payload, remaining, eos_id=eos_id, sampling=sampling,
+            submitted_at=submitted_at, traceparent=traceparent,
+            transfer_s=transfer_s, transfer_bytes=transfer_bytes,
+            resume=True)
+
+    async def export_session(self, stream,
+                             timeout_s: float = 5.0):
+        """Snapshot a live decode session for migration (ISSUE 12): the
+        source half of ``migrate_session``. Quiesces the slot (it joins
+        no further ticks; in-flight tokens drain through the normal
+        publish path so the client sees them), then stages the slot's
+        committed KV pages plus its decode state (cache length, last
+        token, sampling params, PRNG key) to host and retires the slot —
+        pages return to the free list, the stream ends cleanly, and the
+        flight record closes with status ``migrated``.
+
+        Returns ``(payload, state)``: a session-flagged
+        :class:`~gofr_tpu.tpu.kv_wire.KVPayload` and a host-state dict
+        (``remaining`` budget, ``eos_id``, sampling params, ``emitted``
+        token count) for the adopting replica's
+        :meth:`adopt_session`. Token identity holds across the hop: the
+        target's first decode tick reads exactly the device state this
+        snapshot froze. Raises ``KeyError`` when the stream is not bound
+        to a slot (not yet admitted, or already finished), ``ValueError``
+        for constrained sessions (the grammar walker is host state that
+        does not ship), ``TimeoutError`` when in-flight ticks fail to
+        drain in ``timeout_s``."""
+        from gofr_tpu.tpu import kv_wire
+        if not self.paged:
+            raise ValueError("export_session needs paged_kv=True (the "
+                             "session ships as page-pool rows)")
+        queue = getattr(stream, "_queue", stream)
+        slot_idx = next((i for i, s in enumerate(self._slots)
+                         if s.queue is queue), None)
+        if slot_idx is None:
+            raise KeyError("stream is not bound to a live slot")
+        slot = self._slots[slot_idx]
+        if slot.grammar is not None:
+            raise ValueError("constrained sessions hold host-side "
+                             "grammar state and cannot migrate")
+        gen0 = slot.gen
+        slot.migrating = True
+
+        def live() -> bool:
+            return (slot.gen == gen0 and slot.queue is queue
+                    and slot.active)
+
+        try:
+            deadline = time.monotonic() + timeout_s
+            while slot.inflight > 0:
+                if not live():
+                    raise RuntimeError(
+                        "session finished before it could be exported")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "in-flight decode ticks did not drain in "
+                        f"{timeout_s}s")
+                await asyncio.sleep(0.001)
+            if not live():
+                raise RuntimeError(
+                    "session finished before it could be exported")
+
+            fill = slot.fill
+            page = self.kv_page
+            n_pages = -(-fill // page)
+            ids = [int(self._table[slot_idx, j]) for j in range(n_pages)]
+            if any(pid == self._pool.sentinel for pid in ids):
+                raise RuntimeError(
+                    f"slot {slot_idx} table row holds a sentinel inside "
+                    f"its {n_pages}-page fill span")
+            codec = kv_wire.codec_for_cfg(self.cfg)
+            names = kv_wire.leaf_names(codec)
+            jnp = self._jnp
+
+            def snapshot():
+                # device→host staging on a worker thread (GT006), under
+                # the pool lock so a concurrent donating dispatch cannot
+                # alias the leaves mid-gather
+                idx = np.asarray(ids, np.int32)
+                with self._pool.lock:
+                    host = {name: np.asarray(
+                                self._pool.leaves[name][:, jnp.asarray(idx)])
+                            for name in names}
+                    last = int(np.asarray(self.last_token)[slot_idx])
+                    key_row = np.asarray(self.sample_keys)[slot_idx]
+                    temp = float(np.asarray(self.temps)[slot_idx])
+                    top_k = int(np.asarray(self.top_ks)[slot_idx])
+                    top_p = float(np.asarray(self.top_ps)[slot_idx])
+                return (host, last, (int(key_row[0]), int(key_row[1])),
+                        temp, top_k, top_p)
+
+            loop = asyncio.get_running_loop()
+            host, last, key, temp, top_k, top_p = \
+                await loop.run_in_executor(None, snapshot)
+            if not live():
+                raise RuntimeError("session was cancelled during export")
+        except BaseException:
+            slot.migrating = False   # re-joins ticks if still live
+            raise
+
+        payload = kv_wire.KVPayload(
+            codec=codec, dtype=host["k"].dtype.name, page=page,
+            tokens=fill, n_layers=self.cfg.n_layers,
+            n_kv_heads=self.cfg.n_kv_heads, head_dim=self.cfg.head_dim,
+            n_pages=n_pages, first_token=last, sample_key=key,
+            model=self.model_name, leaves=host,
+            flags=kv_wire.FLAG_SESSION)
+        state = {
+            "remaining": slot.remaining,
+            "eos_id": slot.eos_id,
+            "temperature": temp,
+            "top_k": top_k,
+            "top_p": top_p,
+            "emitted": len(slot.tokens),
+            "cls": slot.cls,
+        }
+
+        # retire the source slot: stale in-flight state is impossible
+        # (inflight drained above), so this is the normal teardown minus
+        # the token publish — the remainder of the completion streams
+        # from the adopting replica
+        slot.active = False
+        slot.migrating = False
+        slot.gen += 1
+        slot.inflight = 0
+        q = slot.queue
+        slot.queue = None
+        self._release_slot_kv(slot_idx, slot)
+        self._session_exports += 1
+        self._finish_slot(slot, "migrated")
+        if slot.future is not None and not slot.future.done():
+            # non-streaming waiters get the tokens this replica produced;
+            # the fleet relay ignores the future and splices streams
+            slot.future.set_result(list(slot.tokens))
+        self._free.append(slot_idx)
+        if q is not None:
+            q.put_nowait(_DONE)
+        return payload, state
+
+    def prefix_digest(self,
+                      max_entries: int = 512) -> Optional[Dict[str, Any]]:
+        """Compact digest of resident prefix-cache chains for fleet
+        routing (tpu/fleet.py); None when no prefix cache is wired."""
+        if self._prefix is None:
+            return None
+        return self._prefix.digest(max_entries=max_entries)
 
     def _cancel_stream(self, queue: asyncio.Queue) -> None:
         """Abandon the request bound to ``queue``: free its slot (in-flight
@@ -2047,6 +2234,11 @@ class GenerationEngine:
                # admitted with ZERO local prefill dispatches
                "kv_exports": self._kv_exports,
                "kv_adoptions": self._kv_adoptions,
+               # live-migration accounting (ISSUE 12): both ride the
+               # zero-re-prefill path, so these never move the prefill
+               # counters above
+               "session_exports": self._session_exports,
+               "session_adoptions": self._session_adoptions,
                "max_len": self.max_len,
                "window_ladder": [w or self.max_len
                                  for w in self._window_ladder],
@@ -2477,6 +2669,16 @@ class GenerationEngine:
                     and not self._overflow):
                 self._wake.clear()
                 await self._wake.wait()
+            else:
+                # Active or queued work exists but this pass produced no
+                # dispatch — e.g. every active slot is quiescing for a
+                # migration export, or admission is page-deferred. The
+                # admit/dispatch coroutines above return without ever
+                # suspending in that state, so without a real sleep this
+                # loop would monopolize the event loop and starve the
+                # very coroutines (exporter quiesce poll, stream
+                # consumers) that unblock it.
+                await asyncio.sleep(0.001)
             return
 
         # 3. publish in dispatch order (per-slot token order). Block on the
@@ -2781,6 +2983,7 @@ class GenerationEngine:
                 slot.remaining = budget
                 slot.eos_id = eos_id
                 slot.tokens = []
+                slot.migrating = False
                 slot.active = True
                 slot.gen += 1
                 slot.inflight = 1          # the prefill's first token
@@ -3154,6 +3357,7 @@ class GenerationEngine:
         eligible = [(slot_idx, slot)
                     for slot_idx, slot in enumerate(self._slots)
                     if slot.active and slot.remaining > slot.inflight
+                    and not slot.migrating
                     and (slot.grammar is None or slot.inflight == 0)]
         if not eligible:
             return None

@@ -31,15 +31,17 @@ fail loudly before it poisons a decode replica's pool.
 
 from __future__ import annotations
 
+import os
 import struct
-from typing import Any, Dict, Iterable, Iterator, List, Tuple
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 __all__ = [
-    "CODEC_RAW", "CODEC_INT8", "KVPayload", "KVWireError",
+    "CODEC_RAW", "CODEC_INT8", "FLAG_SESSION", "KVPayload", "KVWireError",
     "codec_for_cfg", "resolve_codec", "leaf_names", "leaf_shape",
     "pack", "unpack", "iter_chunks", "assemble", "DEFAULT_CHUNK_BYTES",
+    "MIN_CHUNK_BYTES", "MAX_CHUNK_BYTES", "resolve_chunk_bytes",
 ]
 
 MAGIC = b"GKVW"
@@ -47,9 +49,47 @@ VERSION = 1
 CODEC_RAW = 0    # k/v in the pool dtype (bf16 unless cfg overrides)
 CODEC_INT8 = 1   # int8 k/v + float32 ks/vs scale planes
 
+# header flag bits: a SESSION payload is a live decode session snapshot
+# (mid-stream migration, ISSUE 12) — its first_token is the *last
+# committed* token decode resumes from, not a freshly-sampled prompt
+# token, and it must be admitted through adopt_session, never adopt_kv
+FLAG_SESSION = 0x01
+
 # gRPC defaults cap messages at 4 MiB; 256 KiB chunks keep each frame
 # far under the cap and let the receiver overlap reassembly with I/O
 DEFAULT_CHUNK_BYTES = 256 << 10
+# the KV_WIRE_CHUNK_BYTES knob is clamped to this window: below 4 KiB
+# the per-frame overhead dominates, at/above the 4 MiB gRPC message cap
+# a frame head-of-line blocks the transport (arxiv 1804.01138)
+MIN_CHUNK_BYTES = 4 << 10
+MAX_CHUNK_BYTES = 4 << 20
+
+
+def resolve_chunk_bytes(value: Optional[Any] = None) -> int:
+    """Resolve the transfer-frame size: an explicit ``value`` wins, else
+    the ``KV_WIRE_CHUNK_BYTES`` env knob, else the default. The knob is
+    validated at resolve time — a malformed or out-of-bounds value is a
+    deploy-time config error (fail loudly), never a silently-degenerate
+    frame size."""
+    if value is None:
+        raw = os.environ.get("KV_WIRE_CHUNK_BYTES", "").strip()
+        if not raw:
+            return DEFAULT_CHUNK_BYTES
+        try:
+            n = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"KV_WIRE_CHUNK_BYTES={raw!r} is not an integer") from None
+        if not MIN_CHUNK_BYTES <= n < MAX_CHUNK_BYTES:
+            raise ValueError(
+                f"KV_WIRE_CHUNK_BYTES={n} outside [{MIN_CHUNK_BYTES}, "
+                f"{MAX_CHUNK_BYTES}): frames must stay under the 4 MiB "
+                "gRPC message cap and above the framing-overhead floor")
+        return n
+    n = int(value)
+    if n <= 0:
+        raise ValueError("chunk_bytes must be positive")
+    return n
 
 # magic, version, codec, flags, page, tokens, n_layers, n_kv_heads,
 # head_dim, n_pages, first_token, key0, key1
@@ -74,13 +114,14 @@ class KVPayload:
 
     __slots__ = ("codec", "dtype", "page", "tokens", "n_layers",
                  "n_kv_heads", "head_dim", "n_pages", "first_token",
-                 "sample_key", "model", "leaves")
+                 "sample_key", "model", "leaves", "flags")
 
     def __init__(self, codec: int, dtype: str, page: int, tokens: int,
                  n_layers: int, n_kv_heads: int, head_dim: int,
                  n_pages: int, first_token: int,
                  sample_key: Tuple[int, int], model: str,
-                 leaves: Dict[str, Any]):
+                 leaves: Dict[str, Any], flags: int = 0):
+        self.flags = int(flags)
         self.codec = int(codec)
         self.dtype = str(dtype)
         self.page = int(page)
@@ -102,6 +143,7 @@ class KVPayload:
             "tokens": self.tokens,
             "n_pages": self.n_pages,
             "model": self.model,
+            "session": bool(self.flags & FLAG_SESSION),
         }
 
 
@@ -182,7 +224,8 @@ def pack(payload: KVPayload) -> bytes:
     if len(dtype_b) > 255 or len(model_b) > 255:
         raise KVWireError("dtype/model names exceed 255 bytes")
     parts: List[bytes] = [
-        _HEAD.pack(MAGIC, VERSION, payload.codec, 0, payload.page,
+        _HEAD.pack(MAGIC, VERSION, payload.codec,
+                   payload.flags & 0xFF, payload.page,
                    payload.tokens, payload.n_layers, payload.n_kv_heads,
                    payload.head_dim, payload.n_pages,
                    payload.first_token,
@@ -220,7 +263,7 @@ def unpack(data) -> KVPayload:
         raise KVWireError(
             f"truncated KV payload: {len(data)} bytes < "
             f"{_HEAD.size}-byte header")
-    (magic, version, codec, _flags, page, tokens, n_layers, n_kv_heads,
+    (magic, version, codec, flags, page, tokens, n_layers, n_kv_heads,
      head_dim, n_pages, first_token, key0, key1) = _HEAD.unpack_from(data)
     if magic != MAGIC:
         raise KVWireError(f"bad KV payload magic {magic!r}")
@@ -241,7 +284,7 @@ def unpack(data) -> KVPayload:
             f"{-(-tokens // page)} pages of {page}, header says {n_pages}")
     payload = KVPayload(codec, dtype, page, tokens, n_layers, n_kv_heads,
                         head_dim, n_pages, first_token, (key0, key1),
-                        model, {})
+                        model, {}, flags=flags)
     for name in names:
         if off + _SIZE.size > len(data):
             raise KVWireError(f"truncated KV payload at leaf {name!r}")
@@ -280,12 +323,13 @@ def _read_str(data, off: int, what: str) -> Tuple[str, int]:
 
 
 def iter_chunks(data: bytes,
-                chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> Iterator[bytes]:
+                chunk_bytes: Optional[int] = None) -> Iterator[bytes]:
     """Split a packed payload into bounded transfer frames (the gRPC
     stream / chunked-HTTP unit). Order-preserving; ``assemble`` is the
-    inverse."""
-    if chunk_bytes <= 0:
-        raise ValueError("chunk_bytes must be positive")
+    inverse. ``chunk_bytes=None`` resolves the validated
+    ``KV_WIRE_CHUNK_BYTES`` knob (default 256 KiB) — large migrations
+    must not head-of-line block the transport behind one giant frame."""
+    chunk_bytes = resolve_chunk_bytes(chunk_bytes)
     for start in range(0, len(data), chunk_bytes):
         yield data[start:start + chunk_bytes]
     if not data:

@@ -48,11 +48,39 @@ prefill (decode already reads the quantized cache either way).
 
 from __future__ import annotations
 
+import hashlib
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from gofr_tpu.tpu.page_pool import PagePool
 
-__all__ = ["PrefixStore"]
+__all__ = ["PrefixStore", "chain_hashes"]
+
+
+def _chain_hash(parent: bytes, key: Sequence[int]) -> bytes:
+    """One link of the page-chain hash: H(parent_digest || page tokens).
+    Chaining (vs hashing each page alone) makes a digest entry identify
+    the page's full *prefix*, so two replicas caching the same page
+    under different histories never collide in the fleet index."""
+    h = hashlib.blake2b(parent, digest_size=8)
+    h.update(b"".join(int(t).to_bytes(4, "little", signed=True)
+                      for t in key))
+    return h.digest()
+
+
+def chain_hashes(tokens: Sequence[int], page: int,
+                 max_pages: int = 64) -> List[str]:
+    """Chained page-prefix hashes of a prompt's head — ``out[i]``
+    identifies ``tokens[:(i+1)*page]``. The fleet router computes these
+    for an incoming prompt and intersects them with replica digests; the
+    longest match is the replica holding the deepest resident prefix.
+    Only full pages participate (partial tail pages are never cached)."""
+    out: List[str] = []
+    parent = b""
+    for i in range(min(len(tokens) // page, max_pages)):
+        parent = _chain_hash(parent, tokens[i * page:(i + 1) * page])
+        out.append(parent.hex())
+    return out
 
 
 class _PageNode:
@@ -351,6 +379,34 @@ class PrefixStore:
         if self.metrics is not None and self.num_pages:
             self.metrics.set_gauge("app_tpu_prefix_cache_occupancy",
                                    self.used_pages / self.num_pages)
+
+    def digest(self, max_entries: int = 512) -> Dict[str, Any]:
+        """Compact fleet-routing view of the resident trie (ISSUE 12):
+        chained page-prefix hashes (same chaining as
+        :func:`chain_hashes`, so a router can match an incoming prompt
+        without ever seeing raw tokens) plus pool occupancy. BFS order
+        guarantees every included entry's own prefix chain is also
+        included, so truncation at ``max_entries`` only drops the
+        *deepest* chains — a match against a truncated digest is still
+        exact, just possibly shorter than the resident prefix."""
+        entries: List[str] = []
+        queue: "deque[Tuple[_PageNode, bytes]]" = deque(
+            (child, b"") for child in self._root.children.values())
+        while queue and len(entries) < max_entries:
+            node, parent = queue.popleft()
+            digest = _chain_hash(parent, node.key)
+            entries.append(digest.hex())
+            for child in node.children.values():
+                queue.append((child, digest))
+        return {
+            "page": self.page,
+            "entries": entries,
+            "truncated": bool(queue),
+            "used_pages": self.used_pages,
+            "num_pages": self.num_pages,
+            "occupancy": (round(self.used_pages / self.num_pages, 6)
+                          if self.num_pages else 0.0),
+        }
 
     def stats(self) -> Dict[str, Any]:
         lookups = self.hits + self.partial_hits + self.misses

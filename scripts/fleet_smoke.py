@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Tier-1 fleet smoke: a 3-replica fleet in ONE process, in-proc
+transports, tiny model on forced host devices.
+
+Drives the fleet control plane end-to-end — a cold prompt lands via the
+fallback pick and builds radix-cache residency, the clusterz digest
+refresh teaches the router where the prefix lives, a shared-prefix
+repeat routes back to the holder by affinity, one live session migrates
+between replicas mid-stream, and one autoscale step fires — and asserts
+the acceptance properties cheap enough to gate every commit on:
+
+1. an affinity hit on the digest-indexed holder (not registry rotation),
+2. migration is token-identical to monolithic serving with zero prefill
+   dispatches on the target, and
+3. the autoscaler's decision kernel scales up under forced pressure.
+
+Prints ``fleet smoke: OK`` and exits 0, or raises with the failing
+property. Budget: a few seconds on 8 host CPU devices.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.cluster import (ROLE_BOTH, ClusterRegistry,
+                                      InProcTransport)
+    from gofr_tpu.tpu.fleet import Autoscaler, FleetRouter
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+
+    def build():
+        container = new_mock_container()
+        return GenerationEngine(cfg, params, max_slots=2, max_len=32,
+                                prompt_buckets=(8,), kv_page=4,
+                                paged_kv=True, prefix_cache=True,
+                                logger=container.logger,
+                                metrics=container.metrics)
+
+    warm = [1, 2, 3, 4, 5, 6, 7, 8]            # 2 full pages
+    repeat = warm[:4] + [21, 22, 23]           # shares page 1 only
+    mig_prompt, mig_budget = [9, 8, 7], 10
+
+    async def monolithic():
+        engine = build()
+        await engine.start()
+        try:
+            return await asyncio.wait_for(engine.generate(
+                mig_prompt, max_new_tokens=mig_budget), 60.0)
+        finally:
+            await engine.stop()
+
+    async def fleet(ref):
+        engines = {name: build() for name in ("d0", "d1", "d2")}
+        cluster = ClusterRegistry()
+        for name, engine in engines.items():
+            cluster.register(name, ROLE_BOTH, InProcTransport(engine))
+        router = FleetRouter(cluster)
+        for engine in engines.values():
+            await engine.start()
+        try:
+            # 1) affinity: cold prompt builds residency somewhere, the
+            # digest refresh indexes it, the repeat routes back to it
+            session = await router.generate_stream(warm, 4)
+            async for _ in session:
+                pass
+            holder = session.replica_name
+            await router.refresh()
+            assert router.index.stats()["entries"].get(holder, 0) > 0, \
+                "digest refresh left the holder out of the index"
+            picked, depth = router._route(repeat)
+            assert picked.name == holder and depth == 1, \
+                (picked.name, depth, holder)
+            out = await asyncio.wait_for(
+                router.generate(repeat, max_new_tokens=4), 60.0)
+            assert len(out) == 4
+            routing = router.fleet_stats()["routing"]
+            assert routing["affinity"] >= 2, routing
+
+            # 2) live migration: token identity, zero re-prefill
+            session = await router.generate_stream(
+                mig_prompt, max_new_tokens=mig_budget)
+            tokens = [await asyncio.wait_for(session.__anext__(), 60.0)
+                      for _ in range(2)]
+            source = session.replica_name
+            prefill_before = {n: e.stats()["prefill_bucket_tokens"]
+                              for n, e in engines.items()}
+            target = await router.migrate_session(session)
+            assert target != source
+            async for token in session:
+                tokens.append(token)
+            assert tokens == ref, \
+                f"migration broke token identity: {tokens} != {ref}"
+            tgt_stats = engines[target].stats()
+            assert tgt_stats["prefill_bucket_tokens"] == \
+                prefill_before[target], "target re-prefilled migrated KV"
+            assert tgt_stats["session_adoptions"] == 1
+            assert engines[source].stats()["session_exports"] == 1
+
+            # 3) one autoscale step under forced pressure
+            grown = []
+            scaler = Autoscaler(
+                cluster, scale_up=lambda: grown.append(1),
+                scale_down=lambda name: None, router=router,
+                up_after=1, cooldown_s=0.0,
+                signals_fn=lambda: {"queue_depth": 99,
+                                    "decode_replicas": 3},
+                max_decode=4)
+            event = await scaler()
+            assert event["result"] == "up" and grown == [1], event
+            router.autoscaler = scaler
+        finally:
+            for engine in engines.values():
+                await engine.stop()
+
+    ref = asyncio.run(monolithic())
+    asyncio.run(fleet(ref))
+    print("fleet smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,10 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/clusterz_smoke.py || exit
 # async batch lane smoke: pub/sub jobs -> WFQ batch class -> results,
 # constrained decoding, dead-letter envelope, backpressure pause/resume
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/batch_lane_smoke.py || exit 1
+# fleet control-plane smoke: prefix-affinity routing off the clusterz
+# digest, one live mid-stream migration (token identity, zero
+# re-prefill), one forced autoscale step
+timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

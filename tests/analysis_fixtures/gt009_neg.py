@@ -1,0 +1,46 @@
+"""GT009 negative fixture: cron handlers that cannot overlap themselves.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+_BUSY = {"sweep": False}
+
+
+async def guarded_sweep(ctx):
+    # single-flight: the guard bails out before the first await, so an
+    # overlapping firing is a no-op instead of a second sweep
+    if _BUSY["sweep"]:
+        return
+    _BUSY["sweep"] = True
+    try:
+        for replica in ctx.container.cluster.replicas():
+            await replica.observe()
+    finally:
+        _BUSY["sweep"] = False
+
+
+async def bounded_tick(ctx):
+    # no await at all: the handler is bounded by construction
+    ctx.container.metrics.set_gauge("app_demo_tick", 1.0)
+
+
+def heartbeat(ctx):
+    # synchronous handlers cannot be re-entered by the cron plane
+    return {"ok": True}
+
+
+# graftcheck: ignore[GT009] — fixture: idempotent sweep, overlap is safe
+async def idempotent_gc(ctx):
+    await ctx.container.cluster.collect_garbage()
+
+
+def wire(app):
+    app.add_cron_job("* * * * *", "guarded-sweep", guarded_sweep)
+    app.add_cron_job("* * * * *", "bounded-tick", bounded_tick)
+    app.add_cron_job("* * * * *", "heartbeat", heartbeat)
+    app.add_cron_job("17 * * * *", "gc", idempotent_gc)
+    # bound-method / instance handlers are not statically resolvable —
+    # the rule skips them rather than guessing
+    app.add_cron_job("* * * * *", "autoscale", app.container.autoscaler)
+    # an add_job on a non-cron receiver is someone else's scheduler
+    app.scheduler.add_job("* * * * *", "other", object())

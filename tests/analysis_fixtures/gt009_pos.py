@@ -1,0 +1,24 @@
+"""GT009 positive fixture: re-entrant cron handlers.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+
+async def probe_sweep(ctx):
+    # unbounded await, no guard: a slow sweep overlaps the next firing
+    for replica in ctx.container.cluster.replicas():
+        await replica.observe()
+
+
+async def rebalance(ctx):
+    # guard exists but sits AFTER the first await — two firings both
+    # pass the await before either sets the flag
+    snapshot = await ctx.container.cluster.snapshot()
+    if snapshot.busy:
+        return
+    await ctx.container.cluster.rebalance(snapshot)
+
+
+def wire(app):
+    app.add_cron_job("* * * * *", "probe-sweep", probe_sweep)
+    app.crontab.add_job("*/5 * * * *", "rebalance", func=rebalance)

@@ -1,0 +1,440 @@
+"""Fleet control plane (ISSUE 12): prefix-affinity routing, live
+decode→decode migration, and the autoscaler's decision kernel.
+
+The load-bearing contracts, in order:
+
+1. AFFINITY ROUTES TO RESIDENCY — a replica's clusterz digest is enough
+   for the router to steer a shared-prefix request back to the replica
+   whose radix cache already holds the prefix; a cold prompt falls back
+   to the least-inflight pick.
+2. MIGRATION IS INVISIBLE — a mid-stream session migrated between
+   replicas emits exactly the monolithic engine's token stream, with
+   zero prefill dispatches on the target (``prefill_bucket_tokens`` 0:
+   shipped pages become page-table entries, never a prefill), and the
+   source's pages return to its free list.
+3. DRAIN IS MIGRATE-OUT — draining a replica with live sessions moves
+   them to a peer and completes immediately instead of waiting out the
+   decode budget; the drained replica takes no new routes.
+4. THE AUTOSCALER IS BORING — hysteresis streaks, cooldown, the
+   compile-ledger guard, and single-flight overlap protection all hold
+   before a scale callback ever fires.
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.tpu import kv_wire
+from gofr_tpu.tpu.cluster import (ROLE_BOTH, ROLE_DECODE, ClusterRegistry,
+                                  InProcTransport)
+from gofr_tpu.tpu.fleet import (Autoscaler, FleetPrefixIndex, FleetRouter,
+                                FleetSession)
+from gofr_tpu.tpu.generate import GenerationEngine
+from gofr_tpu.tpu.prefix_cache import chain_hashes
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _make_engine(cfg, params, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    engine = GenerationEngine(cfg, params, logger=container.logger,
+                              metrics=container.metrics, **kwargs)
+    return engine, container
+
+
+async def _reference(cfg, params, prompt, budget):
+    engine, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4)
+    await engine.start()
+    try:
+        return await asyncio.wait_for(
+            engine.generate(prompt, max_new_tokens=budget), 60.0)
+    finally:
+        await engine.stop()
+
+
+async def _fleet(cfg, params, names=("d0", "d1"), **engine_kwargs):
+    """N in-proc ``both`` replicas behind a FleetRouter — every replica
+    owns a paged pool and its own admission path (so its radix cache
+    builds residency), the topology affinity routing exists for."""
+    engine_kwargs.setdefault("paged_kv", True)
+    engine_kwargs.setdefault("kv_page", 4)
+    engines = {}
+    cluster = ClusterRegistry()
+    for name in names:
+        engine, _ = _make_engine(cfg, params, **engine_kwargs)
+        engines[name] = engine
+        cluster.register(name, ROLE_BOTH, InProcTransport(engine))
+    router = FleetRouter(cluster)
+    for engine in engines.values():
+        await engine.start()
+    return engines, cluster, router
+
+
+async def _stop(engines):
+    for engine in engines.values():
+        await engine.stop()
+
+
+# -- kv_wire chunk-size knob (satellite) --------------------------------------
+
+def test_chunk_bytes_env_knob(monkeypatch):
+    monkeypatch.delenv("KV_WIRE_CHUNK_BYTES", raising=False)
+    assert kv_wire.resolve_chunk_bytes() == kv_wire.DEFAULT_CHUNK_BYTES
+
+    monkeypatch.setenv("KV_WIRE_CHUNK_BYTES", str(64 << 10))
+    assert kv_wire.resolve_chunk_bytes() == 64 << 10
+
+    for bad in ("12",                       # under the 4 KiB floor
+                str(kv_wire.MAX_CHUNK_BYTES),   # at the 4 MiB cap
+                "not-a-number"):
+        monkeypatch.setenv("KV_WIRE_CHUNK_BYTES", bad)
+        with pytest.raises(ValueError, match="KV_WIRE_CHUNK_BYTES"):
+            kv_wire.resolve_chunk_bytes()
+
+    # explicit values bypass the knob window (tests use tiny frames)
+    monkeypatch.setenv("KV_WIRE_CHUNK_BYTES", "12")
+    assert kv_wire.resolve_chunk_bytes(7) == 7
+    chunks = list(kv_wire.iter_chunks(b"x" * 100, chunk_bytes=7))
+    assert sum(len(c) for c in chunks) == 100
+    assert all(len(c) <= 7 for c in chunks)
+
+
+# -- registry: least-inflight pick (satellite) --------------------------------
+
+class _FakeTransport:
+    kind = "fake"
+
+    def available(self):
+        return True
+
+    def health_check(self):
+        return {"status": "UP"}
+
+    def describe(self):
+        return {"kind": self.kind}
+
+
+def test_pick_prefers_least_inflight():
+    cluster = ClusterRegistry()
+    cluster.register("d0", "decode", _FakeTransport())
+    cluster.register("d1", "decode", _FakeTransport())
+    busy = cluster._require("d0")
+    cluster.note_start(busy)
+    # d0 carries a stream: every pick goes to the idle replica, not RR
+    assert all(cluster.pick(ROLE_DECODE).name == "d1" for _ in range(4))
+    cluster.note_end(busy)
+    picked = {cluster.pick(ROLE_DECODE).name for _ in range(4)}
+    assert picked == {"d0", "d1"}        # tied again: RR spreads
+
+
+# -- prefix index -------------------------------------------------------------
+
+def test_prefix_index_depth_ties_and_page_guard():
+    idx = FleetPrefixIndex()
+    hashes = chain_hashes(list(range(1, 13)), 4)       # 3 full pages
+    assert len(hashes) == 3
+    idx.update("a", {"page": 4, "entries": hashes[:2], "occupancy": 0.5})
+    idx.update("b", {"page": 4, "entries": hashes[:1], "occupancy": 0.1})
+    assert idx.page == 4
+    assert idx.match_depth("a", hashes) == 2
+    assert idx.match_depth("b", hashes) == 1
+    assert idx.best(hashes, ["a", "b"]) == ("a", 2)
+    assert idx.best(hashes, ["b"]) == ("b", 1)
+
+    # equal depth: the tie goes to the lower-occupancy replica
+    idx.update("a", {"page": 4, "entries": hashes[:1], "occupancy": 0.5})
+    assert idx.best(hashes, ["a", "b"]) == ("b", 1)
+
+    # a digest at a different page size cannot match chained hashes —
+    # the replica drops out of the index instead of poisoning it
+    idx.update("a", {"page": 8, "entries": hashes[:1], "occupancy": 0.0})
+    assert idx.match_depth("a", hashes) == 0
+    assert idx.stats()["replicas"] == ["b"]
+
+    cold = chain_hashes([99, 98, 97, 96], 4)
+    assert idx.best(cold, ["b"]) == (None, 0)
+    idx.drop("b")
+    assert idx.stats()["replicas"] == []
+
+
+# -- tentpole: affinity routing ----------------------------------------------
+
+def test_affinity_routes_repeat_prefix_to_the_holder(setup):
+    cfg, params = setup
+    prompt = list(range(1, 13))                        # 3 full pages
+
+    async def run():
+        engines, cluster, router = await _fleet(
+            cfg, params, prefix_cache=True)
+        try:
+            # cold prompt: fallback pick serves it locally and, in doing
+            # so, builds residency in that replica's radix cache
+            session = await router.generate_stream(prompt, 6)
+            assert isinstance(session, FleetSession)
+            first = [token async for token in session]
+            assert len(first) == 6
+            holder = session.replica_name
+            assert router.fleet_stats()["routing"] == {
+                "affinity": 0, "fallback": 1}
+
+            # the clusterz probe carries the digest into the index
+            await router.refresh()
+            stats = router.index.stats()
+            assert stats["page"] == 4
+            assert stats["entries"].get(holder, 0) > 0
+
+            # same 2-page prefix, different tail: affinity finds the
+            # holder even though the registry's RR would rotate away
+            repeat = prompt[:8] + [77, 78]
+            replica, depth = router._route(repeat)
+            assert replica.name == holder and depth == 2
+            out = await asyncio.wait_for(router.generate(repeat, 6), 60.0)
+            assert len(out) == 6
+            assert router.fleet_stats()["routing"]["affinity"] == 2
+
+            # a cold prompt still falls back
+            replica, depth = router._route([51, 52, 53, 54, 55])
+            assert depth == 0 and replica is not None
+        finally:
+            await _stop(engines)
+
+    asyncio.run(run())
+
+
+# -- tentpole: live migration -------------------------------------------------
+
+def test_migration_mid_stream_is_token_identical(setup):
+    cfg, params = setup
+    prompt, budget = [1, 2, 3, 4, 5], 24
+    ref = asyncio.run(_reference(cfg, params, prompt, budget))
+
+    async def run():
+        engines, cluster, router = await _fleet(cfg, params)
+        try:
+            baseline = {name: engine._pool.free_pages
+                        for name, engine in engines.items()}
+            session = await router.generate_stream(prompt, budget)
+            tokens = [await asyncio.wait_for(session.__anext__(), 60.0)
+                      for _ in range(2)]
+            source = session.replica_name
+
+            target = await router.migrate_session(session)
+            assert target != source
+            assert session.replica_name == target
+            assert session.migrations == 1
+
+            async for token in session:
+                tokens.append(token)
+            assert tokens == ref                      # token identity
+
+            src_eng, tgt_eng = engines[source], engines[target]
+            # zero re-prefill: the shipped pages were adopted, the
+            # target never ran a prefill dispatch for this session
+            assert tgt_eng.stats()["prefill_bucket_tokens"] == 0
+            assert tgt_eng.stats()["session_adoptions"] == 1
+            assert src_eng.stats()["session_exports"] == 1
+
+            # the source's pages ride the normal teardown back to free
+            for _ in range(200):
+                if src_eng._pool.free_pages == baseline[source]:
+                    break
+                await asyncio.sleep(0.02)
+            assert src_eng._pool.free_pages == baseline[source]
+            assert router.fleet_stats()["migrations"] == {
+                "ok": 1, "failed": 0}
+        finally:
+            await _stop(engines)
+
+    asyncio.run(run())
+
+
+def test_migration_rejects_double_inflight_and_bad_target(setup):
+    cfg, params = setup
+
+    async def run():
+        engines, cluster, router = await _fleet(cfg, params)
+        try:
+            session = await router.generate_stream([1, 2, 3], 16)
+            await asyncio.wait_for(session.__anext__(), 60.0)
+            source = session.replica_name
+            with pytest.raises(ValueError, match="target equals"):
+                await router.migrate_session(session, target_name=source)
+            # the failed attempt must not leave a splice armed
+            target = await router.migrate_session(session)
+            assert target != source
+            async for _ in session:
+                pass
+        finally:
+            await _stop(engines)
+
+    asyncio.run(run())
+
+
+# -- drain = migrate-out ------------------------------------------------------
+
+def test_drain_migrates_live_sessions_out(setup):
+    cfg, params = setup
+    prompt, budget = [2, 4, 6, 8], 24
+    ref = asyncio.run(_reference(cfg, params, prompt, budget))
+
+    async def run():
+        engines, cluster, router = await _fleet(cfg, params)
+        try:
+            session = await router.generate_stream(prompt, budget)
+            tokens = [await asyncio.wait_for(session.__anext__(), 60.0)]
+            source = session.replica_name
+            other = next(n for n in engines if n != source)
+
+            drained = await asyncio.wait_for(router.drain(source), 10.0)
+            assert drained is True
+            assert cluster._replicas[source].state == "DRAINING"
+            assert session.replica_name == other
+            assert engines[source].stats()["session_exports"] == 1
+
+            async for token in session:
+                tokens.append(token)
+            assert tokens == ref                      # lossless hand-off
+
+            # the drained replica takes no new routes
+            before = cluster._replicas[other].requests
+            out = await asyncio.wait_for(router.generate(prompt, 4), 60.0)
+            assert len(out) == 4
+            assert cluster._replicas[other].requests == before + 1
+        finally:
+            await _stop(engines)
+
+    asyncio.run(run())
+
+
+# -- autoscaler ---------------------------------------------------------------
+
+class _Ledger:
+    def __init__(self, n):
+        self.n = n
+
+    def serving_compiles(self, window_s):
+        return self.n
+
+
+def _scaler(registry=None, **kwargs):
+    calls = []
+    kwargs.setdefault("min_decode", 1)
+    kwargs.setdefault("max_decode", 3)
+    kwargs.setdefault("queue_high", 4)
+    kwargs.setdefault("queue_low", 1)
+    kwargs.setdefault("up_after", 2)
+    kwargs.setdefault("down_after", 2)
+    kwargs.setdefault("cooldown_s", 0.0)
+    scaler = Autoscaler(registry or ClusterRegistry(),
+                        scale_up=lambda: calls.append("up"),
+                        scale_down=lambda name: calls.append(
+                            ("down", name)),
+                        **kwargs)
+    return scaler, calls
+
+
+def test_autoscaler_hysteresis_and_bounds():
+    async def run():
+        pressure = {"queue_depth": 9, "decode_replicas": 1}
+        scaler, calls = _scaler(signals_fn=lambda: dict(pressure))
+        assert (await scaler())["result"] == "hold"     # streak 1 of 2
+        assert (await scaler())["result"] == "up"
+        assert calls == ["up"]
+        assert (await scaler())["result"] == "hold"     # streak reset
+
+        # at the ceiling pressure never scales
+        pressure["decode_replicas"] = 3
+        scaler, calls = _scaler(signals_fn=lambda: dict(pressure),
+                                up_after=1)
+        assert (await scaler())["result"] == "hold"
+        assert calls == []
+
+    asyncio.run(run())
+
+
+def test_autoscaler_cooldown_and_compile_guard():
+    async def run():
+        pressure = {"queue_depth": 9, "decode_replicas": 1}
+        scaler, calls = _scaler(signals_fn=lambda: dict(pressure),
+                                up_after=1, cooldown_s=1000.0)
+        assert (await scaler())["result"] == "up"
+        assert (await scaler())["result"] == "cooldown"
+        assert calls == ["up"]
+
+        scaler, calls = _scaler(signals_fn=lambda: dict(pressure),
+                                up_after=1, compile_ledger=_Ledger(1))
+        assert (await scaler())["result"] == "compile_guard"
+        assert calls == []
+
+        # a quiet ledger lets the same step through
+        scaler, calls = _scaler(signals_fn=lambda: dict(pressure),
+                                up_after=1, compile_ledger=_Ledger(0))
+        assert (await scaler())["result"] == "up"
+
+    asyncio.run(run())
+
+
+def test_autoscaler_scales_down_idle_fleet_to_the_floor():
+    async def run():
+        cluster = ClusterRegistry()
+        cluster.register("d0", "decode", _FakeTransport())
+        cluster.register("d1", "decode", _FakeTransport())
+        cluster.note_start(cluster._require("d0"))      # d1 is idler
+        idle = {"queue_depth": 0, "decode_replicas": 2}
+        scaler, calls = _scaler(cluster, signals_fn=lambda: dict(idle))
+        assert (await scaler())["result"] == "hold"     # streak 1 of 2
+        event = await scaler()
+        assert event["result"] == "down"
+        assert calls == [("down", "d1")]                # least-inflight
+
+        # at the floor the victim pick refuses
+        idle["decode_replicas"] = 1
+        scaler, calls = _scaler(cluster, signals_fn=lambda: dict(idle),
+                                down_after=1, min_decode=2)
+        assert (await scaler())["result"] == "hold"
+        assert calls == []
+
+    asyncio.run(run())
+
+
+def test_autoscaler_overlapping_firings_are_dropped():
+    async def run():
+        gate = asyncio.Event()
+
+        async def slow_signals():
+            await gate.wait()
+            return {"queue_depth": 0, "decode_replicas": 1}
+
+        scaler, calls = _scaler(signals_fn=slow_signals)
+        first = asyncio.create_task(scaler())
+        await asyncio.sleep(0)                          # enter _gather
+        second = await scaler()
+        assert second["result"] == "overlap"            # dropped, not queued
+        gate.set()
+        assert (await first)["result"] == "hold"
+        status = scaler.status()
+        assert status["busy"] is False
+        assert [e["result"] for e in status["recent"]] == \
+            ["overlap", "hold"]
+
+    asyncio.run(run())
+
+
+def test_autoscaler_rejects_bad_bounds():
+    with pytest.raises(ValueError, match="min_decode"):
+        Autoscaler(ClusterRegistry(), lambda: None, lambda n: None,
+                   min_decode=0)
+    with pytest.raises(ValueError, match="max_decode"):
+        Autoscaler(ClusterRegistry(), lambda: None, lambda n: None,
+                   min_decode=2, max_decode=1)

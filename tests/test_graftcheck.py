@@ -222,6 +222,32 @@ def test_gt008_negative_bounded_labels_exemplar_and_pragma_are_clean():
     assert report.exit_code == 0
 
 
+# -- GT009 cron re-entrancy ---------------------------------------------------
+
+def test_gt009_positive_flags_unguarded_awaiting_handlers():
+    report = scan("gt009_pos.py", "GT009")
+    got = keys(report)
+    assert "cron handler probe_sweep" in got
+    # guard AFTER the first await does not stop the overlap
+    assert "cron handler rebalance" in got
+    assert all(f.rule == "GT009" and f.severity == "error"
+               for f in report.new_findings)
+
+
+def test_gt009_finding_anchors_at_the_handler_definition():
+    report = scan("gt009_pos.py", "GT009")
+    by_key = {f.key: f for f in report.new_findings}
+    rendered = by_key["cron handler probe_sweep"].render()
+    assert "gt009_pos.py" in rendered and "GT009" in rendered
+
+
+def test_gt009_negative_guarded_bounded_and_unresolvable_are_clean():
+    report = scan("gt009_neg.py", "GT009")
+    assert report.new_findings == []
+    assert report.suppressed == 1      # the pragma'd idempotent_gc handler
+    assert report.exit_code == 0
+
+
 # -- engine mechanics --------------------------------------------------------
 
 def _write_module(tmp_path, body):
@@ -347,7 +373,7 @@ def test_cli_list_rules_covers_catalog():
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
         {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007",
-         "GT008"}
+         "GT008", "GT009"}
 
 
 def test_lint_metrics_shim_still_works():
