@@ -116,6 +116,20 @@ REGRESSION_NOTES = {
         "page-pool gather path on a mixed-length workload, pool sized to "
         "HALF the dense reservation — compare against "
         "decode_tok_s_dense from the SAME run, not across rounds"),
+    "llama_ragged_device_tok_s": (
+        "new in r11 (fused ragged paged attention): full compiled "
+        "decode tick — never the kernel alone — ragged vs gather on the "
+        "same geometry (decode_attention post-mortem: an op-level win "
+        "once lost 5x at tick level by breaking XLA's weight prefetch). "
+        "CPU rounds run the kernel in interpret mode, where this number "
+        "is meaningless; token_identical and the executable counts are "
+        "the cross-platform contract. Compare against "
+        "device_only_tok_s_gather from the SAME run, not across rounds"),
+    "llama_ragged_decode_executables": (
+        "new in r11: decode executables compiled while serving the "
+        "mixed-length workload with ragged active — the per-gather-width "
+        "ladder is retired, so this must stay at ONE per (steps, "
+        "sampled) family; growth means the width ladder crept back in"),
     "llama_spec_decode_tok_s": (
         "new in r8 (speculative decode): perfect-draft spec engine vs "
         "target-only control, single-stream on the same f32 config — "
@@ -196,6 +210,10 @@ _LEDGER_PATHS = {
     "llama_prefix_flops_saved_pct": ("llama_prefix_reuse",
                                      "prefill_flops_saved_pct"),
     "llama_paged_decode_tok_s": ("llama_paged_kv", "decode_tok_s_paged"),
+    "llama_ragged_device_tok_s": ("llama_ragged_attn",
+                                  "device_only_tok_s_ragged"),
+    "llama_ragged_decode_executables": ("llama_ragged_attn",
+                                        "decode_executables_ragged"),
     "llama_spec_decode_tok_s": ("llama_speculative", "decode_tok_s_spec"),
     "llama_spec_acceptance_rate": ("llama_speculative", "acceptance_rate"),
     "multi_model_agg_tok_s": ("multi_model", "aggregate_tok_s"),
@@ -289,6 +307,7 @@ def main() -> None:
     llama_small = _llama_decode_bench(on_tpu)
     llama_prefix = _llama_prefix_reuse_bench(on_tpu)
     llama_paged = _llama_paged_kv_bench(on_tpu)
+    llama_ragged = _llama_ragged_attn_bench(on_tpu)
     llama_spec = _llama_speculative_bench(on_tpu)
     llama_disagg = _llama_disagg_bench(on_tpu)
     llama_fleet = _llama_fleet_bench(on_tpu)
@@ -312,6 +331,7 @@ def main() -> None:
         "llama_small_decode": llama_small,
         "llama_prefix_reuse": llama_prefix,
         "llama_paged_kv": llama_paged,
+        "llama_ragged_attn": llama_ragged,
         "llama_speculative": llama_spec,
         "llama_disagg": llama_disagg,
         "llama_fleet": llama_fleet,
@@ -1336,6 +1356,145 @@ def _llama_paged_kv_bench(on_tpu: bool):
         "note": ("pool sized to half the dense reservation; identical "
                  "greedy outputs prove the gather path, the saving is the "
                  "HBM the pool never reserved. Compare dense vs paged "
+                 "within this run, not across rounds"),
+    }
+
+
+def _llama_ragged_attn_bench(on_tpu: bool):
+    """Fused ragged paged attention (docs/tpu/model-serving.md "Ragged
+    paged attention") against a gather-path control of identical
+    geometry. The decode_attention post-mortem applies in full here: a
+    pallas_call inside the per-layer scan once broke XLA's weight
+    prefetch and lost 5x at the TICK level while winning at the op
+    level — so this scenario times the FULL compiled decode tick
+    (device-only chain, donation-threaded) both ways, never the kernel
+    alone. Also reports the determinism contract (`token_identical`:
+    greedy engine streams must match bit-for-bit), the executable-count
+    collapse (ragged retires the per-gather-width ladder), and the HBM
+    gather traffic the kernel stops materializing."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    # tiny geometry on CPU (kernel in interpret mode) keeps the scenario
+    # exercised everywhere; TPU runs the compiled kernel at 4k context
+    if on_tpu:
+        preset, max_len, buckets, page, slots = (
+            "small", 4096, (128, 256), 128, 8)
+    else:
+        preset, max_len, buckets, page, slots = "tiny", 64, (8, 16), 8, 4
+    cfg = llama.config(preset)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    prompts = [[(5 * i + j) % 250 + 1 for j in range(length)]
+               for i, length in enumerate(
+                   [b - 2 for b in buckets] * 2 + [buckets[0] // 2])]
+    budget = 8
+    k_steps = 4
+
+    def build(mode):
+        container = new_mock_container()
+        return GenerationEngine(
+            cfg, params, max_slots=slots, max_len=max_len,
+            prompt_buckets=buckets, steps_per_tick=k_steps,
+            paged_kv=True, kv_page=page, ragged_attn=mode,
+            logger=container.logger, metrics=container.metrics)
+
+    async def drive(engine):
+        await engine.start()
+        try:
+            await asyncio.gather(*[
+                engine.generate(p, max_new_tokens=budget) for p in prompts])
+            start = time.perf_counter()
+            outs = await asyncio.gather(*[
+                engine.generate(p, max_new_tokens=budget) for p in prompts])
+            elapsed = time.perf_counter() - start
+        finally:
+            await engine.stop()
+        tokens = sum(len(o) for o in outs)
+        return outs, tokens / elapsed if elapsed else None
+
+    def device_only(engine):
+        # full-tick chain at full table width, mid-fill context: the
+        # donation-threaded loop cancels the dispatch floor, the token
+        # fetch is the barrier (post-mortem method: measure the tick a
+        # serving engine actually dispatches, weight stream included)
+        pw = engine.pages_per_slot
+        fn = engine._decode_paged_fn(k_steps, pw=pw)
+        fill = (max_len // 2 // page) * page
+        table = np.full((slots, pw), engine._pool.sentinel, np.int32)
+        nxt = 0
+        for b in range(slots):
+            for col in range(fill // page):
+                table[b, col] = nxt % engine._pool.num_pages
+                nxt += 1
+        table = jnp.asarray(table)
+        token = jnp.zeros((slots,), jnp.int32)
+        active = jnp.ones((slots,), bool)
+        pool = engine._pool.leaves
+        cache_len = jnp.full((slots,), fill, jnp.int32)
+        tokens_dev, pool, cache_len = fn(
+            engine.params, token, pool, table, cache_len, active)
+        np.asarray(tokens_dev)                       # warm + barrier
+
+        def chain(n):
+            nonlocal tokens_dev, pool, cache_len
+            t0 = time.perf_counter()
+            for _ in range(n):
+                tokens_dev, pool, cache_len = fn(
+                    engine.params, tokens_dev[-1], pool, table,
+                    cache_len, active)
+            np.asarray(tokens_dev)
+            return time.perf_counter() - t0
+        slopes = [(chain(6) - chain(2)) / 4 for _ in range(2)]
+        tick_s = float(np.median(slopes))
+        return (slots * k_steps / tick_s) if tick_s > 0 else None
+
+    g_eng = build("off")
+    gather_outs, gather_tok_s = asyncio.run(drive(g_eng))
+    r_eng = build("on" if not on_tpu else "auto")
+    ragged_outs, ragged_tok_s = asyncio.run(drive(r_eng))
+    gather_execs = len(g_eng._decode_paged_fns)
+    ragged_execs = len(r_eng._decode_paged_fns)
+    gather_dev = device_only(g_eng)
+    ragged_dev = device_only(r_eng)
+
+    # the gather materialization each tick step stops paying for: K+V
+    # copies of the full gathered window, every layer, every slot
+    itemsize = 1 if cfg.kv_int8 else jnp.dtype(cfg.dtype).itemsize
+    gather_bytes_per_step = (cfg.n_layers * slots * r_eng.pages_per_slot
+                             * page * cfg.n_kv_heads * cfg.head_dim
+                             * itemsize * 2)
+    return {
+        "preset": preset,
+        "attn_path": r_eng.attn_path,
+        "page_tokens": page,
+        "interpret_mode": not on_tpu,
+        # determinism contract: greedy streams identical gather vs ragged
+        "token_identical": gather_outs == ragged_outs,
+        "decode_tok_s_gather": round(gather_tok_s, 1)
+        if gather_tok_s else None,
+        "decode_tok_s_ragged": round(ragged_tok_s, 1)
+        if ragged_tok_s else None,
+        "device_only_tok_s_gather": round(gather_dev, 1)
+        if gather_dev else None,
+        "device_only_tok_s_ragged": round(ragged_dev, 1)
+        if ragged_dev else None,
+        # ladder retirement: executables compiled while serving the SAME
+        # workload (ragged pins one width; gather walks the rung ladder)
+        "decode_executables_gather": gather_execs,
+        "decode_executables_ragged": ragged_execs,
+        "gather_widths_ragged": r_eng.xlaz()["paged_kv"]["gather_widths"],
+        "hbm_gather_bytes_saved_per_step": gather_bytes_per_step,
+        "note": ("CPU runs the kernel in Pallas interpret mode, so "
+                 "device-only numbers only mean something on TPU — "
+                 "token_identical and the executable counts are the "
+                 "cross-platform contract. Compare gather vs ragged "
                  "within this run, not across rounds"),
     }
 

@@ -79,6 +79,11 @@ def build_app():
     kv_int8 = os.environ.get("LLAMA_KV_INT8") == "1"
     paged_kv = os.environ.get("GENERATE_PAGED_KV") == "1"
     kv_page = int(os.environ.get("GENERATE_KV_PAGE", "32"))
+    # fused ragged paged attention: auto = Pallas page-table kernel on
+    # TPU when the geometry tiles, on = force (interpret off-TPU),
+    # off = gather formulation (docs/tpu/model-serving.md)
+    ragged_attn = (os.environ.get("GENERATE_RAGGED_ATTN", "auto")
+                   .strip().lower() or "auto")
     # disaggregated serving: this replica's phase + the remote fleet
     cluster_role = os.environ.get("CLUSTER_ROLE", "both").strip() or "both"
     cluster_peers = parse_peers(os.environ.get("CLUSTER_PEERS"))
@@ -150,6 +155,8 @@ def build_app():
             # prefix cache and decode (MoE serves dense — no paged step)
             paged_kv=paged_kv and module is llama,
             kv_page=kv_page,
+            ragged_attn=(ragged_attn if paged_kv and module is llama
+                         else "auto"),
             kv_pool_bytes=(int(os.environ["GENERATE_KV_POOL_BYTES"])
                            if "GENERATE_KV_POOL_BYTES" in os.environ
                            and page_pool is None else None),

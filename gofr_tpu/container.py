@@ -254,6 +254,10 @@ class Container:
             "app_tpu_kv_ragged_fill_ratio",
             "live tokens / (pages held x page size) across decoding "
             "slots — how ragged the paged KV actually is")
+        metrics.new_counter(
+            "app_tpu_attn_kernel_total",
+            "decode/verify dispatches per attention path "
+            "(ragged|gather|dense) — which formulation served the tick")
         # speculative decode catalog (ISSUE 7): draft-verify acceptance —
         # goodput comes from accepted draft tokens, so the acceptance rate
         # and the adaptive gamma it drives are the first dashboards to read

@@ -397,7 +397,7 @@ def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
 def decode_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
                       token: jnp.ndarray, pool: Dict[str, jnp.ndarray],
                       page_table: jnp.ndarray, cache_len: jnp.ndarray,
-                      active: jnp.ndarray
+                      active: jnp.ndarray, ragged: bool = False
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray],
                                  jnp.ndarray]:
     """One decode step over the unified paged KV pool (ISSUE 6).
@@ -423,6 +423,17 @@ def decode_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
     reason the dense cache does (no stacked-ys rewrite). Returns
     (logits (B, V), pool, cache_len + 1) — the caller freezes inactive
     rows' cache_len, as on the dense path.
+
+    ``ragged=True`` (static) swaps the gather-then-attend formulation
+    for the fused Pallas ragged kernel
+    (ops.pallas.ragged_paged_decode_attention): no (B, P*page) view is
+    materialized — the kernel walks the slot's actual pages via scalar
+    prefetch — so ``page_table`` may carry the slot's *full* table (no
+    ladder rung slicing) and int8 dequant happens in-kernel from the
+    scale planes. Takes priority over ``cfg.use_flash_decode`` and,
+    unlike it, supports int8. Token-identical to the gather path (that
+    formulation remains the correctness oracle and the fallback on
+    unsupported shapes / off-TPU).
     """
     b = token.shape[0]
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
@@ -445,14 +456,22 @@ def decode_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
         layer, idx = layer_and_idx
         planes = [lax.dynamic_index_in_dim(c, idx, 0, keepdims=False)
                   for c in pools]                        # (N, page, ...)
-        views = [gather_kv_pages(p, page_table) for p in planes]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
-        if cfg.use_flash_decode and not int8:
+        if ragged:
+            from gofr_tpu.ops.pallas import ragged_paged_decode_attention
+            attn = ragged_paged_decode_attention(
+                q, planes[0], planes[1], page_table, k[:, 0], v[:, 0],
+                cache_len,
+                k_scale_pages=planes[2] if int8 else None,
+                v_scale_pages=planes[3] if int8 else None)
+        elif cfg.use_flash_decode and not int8:
             from gofr_tpu.ops.pallas import flash_decode_attention
+            views = [gather_kv_pages(p, page_table) for p in planes]
             attn = flash_decode_attention(q, views[0], views[1], k[:, 0],
                                           v[:, 0], cache_len)
         else:
+            views = [gather_kv_pages(p, page_table) for p in planes]
             k_scale = views[2] if int8 else None
             v_scale = views[3] if int8 else None
             attn = decode_attention_cached(q, views[0], views[1], k[:, 0],
@@ -555,7 +574,7 @@ def verify_step(params: Dict[str, Any], cfg: LlamaConfig,
 def verify_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
                       tokens: jnp.ndarray, pool: Dict[str, jnp.ndarray],
                       page_table: jnp.ndarray, cache_len: jnp.ndarray,
-                      active: jnp.ndarray
+                      active: jnp.ndarray, ragged: bool = False
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Paged-pool variant of :func:`verify_step` (unified page pool).
 
@@ -566,6 +585,9 @@ def verify_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
     reallocated, exactly as in :func:`decode_step_paged`. The engine
     guarantees an active row's allocated pages cover
     ``cache_len + G`` before dispatching a γ=G verify rung.
+    ``ragged=True`` runs the fused Pallas kernel's γ+1-query variant
+    over the pool pages directly (no gathered view), same semantics as
+    on :func:`decode_step_paged`.
     """
     b, g_len = tokens.shape
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
@@ -589,13 +611,20 @@ def verify_step_paged(params: Dict[str, Any], cfg: LlamaConfig,
         layer, idx = layer_and_idx
         planes = [lax.dynamic_index_in_dim(c, idx, 0, keepdims=False)
                   for c in pools]
-        views = [gather_kv_pages(p, page_table) for p in planes]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(layer, h, cfg, cos, sin, positions)
-        k_scale = views[2] if int8 else None
-        v_scale = views[3] if int8 else None
-        attn = verify_attention(q, views[0], views[1], k, v, cache_len,
-                                k_scale=k_scale, v_scale=v_scale)
+        if ragged:
+            from gofr_tpu.ops.pallas import ragged_paged_verify_attention
+            attn = ragged_paged_verify_attention(
+                q, planes[0], planes[1], page_table, k, v, cache_len,
+                k_scale_pages=planes[2] if int8 else None,
+                v_scale_pages=planes[3] if int8 else None)
+        else:
+            views = [gather_kv_pages(p, page_table) for p in planes]
+            k_scale = views[2] if int8 else None
+            v_scale = views[3] if int8 else None
+            attn = verify_attention(q, views[0], views[1], k, v, cache_len,
+                                    k_scale=k_scale, v_scale=v_scale)
         x = x + qmm(attn.reshape(b, g_len, -1), layer["wo"])
         h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
         x = x + _ffn(layer, h)

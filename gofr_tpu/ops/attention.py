@@ -13,8 +13,29 @@ TPU-first design notes:
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps softmax NaN-free
+
+
+def _snap(x: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Round f32 values to ``dtype``'s precision without leaving f32.
+
+    The decode/verify formulations round at specific points (score and
+    value einsum outputs, normalized probs) — that rounding schedule IS
+    the numerics contract the ragged Pallas kernel reproduces bit-for-
+    bit. Written as ``lax.reduce_precision`` rather than an astype
+    round-trip because XLA under its default excess-precision setting
+    may elide an f32→bf16→f32 convert pair inside jit, silently moving
+    the rounding points between the eager and compiled runs of the SAME
+    function; ``reduce_precision`` is always preserved, so the oracle
+    is bit-stable under jit and the kernel can match it everywhere.
+    f32 (and wider) dtypes pass through untouched.
+    """
+    info = jnp.finfo(dtype)
+    if info.bits >= 32:
+        return x
+    return lax.reduce_precision(x, info.nexp, info.nmant)
 
 
 def causal_mask(seq_len: int) -> jnp.ndarray:
@@ -97,13 +118,55 @@ def gather_kv_pages(pages: jnp.ndarray,
     attention runs over.
 
     Sentinel ids are out of bounds, and JAX gathers clamp out-of-bounds
-    indices (here: to the last pool row) — safe because every consumer
-    masks key positions >= cache_len, and the engine only dispatches
-    slots whose allocated pages cover cache_len (+ the tick's growth).
+    indices (here: to the last pool row). That is safe only under a
+    contract this function cannot check itself: every table entry
+    covering a position < cache_len must be a real page id, so the
+    clamped garbage always lands at key positions >= cache_len, which
+    every consumer masks to _NEG_INF before the softmax. The engine
+    upholds it by construction (it only dispatches slots whose allocated
+    pages cover cache_len + the tick's growth); tests and debug paths
+    enforce it with :func:`check_sentinel_masked` instead of assuming
+    it. Note the mask guards *scores*, not V values — a NaN in a clamped
+    row would still poison the output through ``0 * NaN`` in the V
+    einsum, which is why pool pages are zero-initialized and the Pallas
+    ragged kernel goes further and never dereferences sentinel entries
+    at all (``pl.when`` skip, asserted by NaN-poisoning tests).
     """
     b, p = page_table.shape
     gathered = pages[page_table]                    # (B, P, page, ...)
     return gathered.reshape(b, p * pages.shape[1], *pages.shape[2:])
+
+
+def check_sentinel_masked(page_table, cache_len, page: int, sentinel: int,
+                          new_tokens: int = 1) -> None:
+    """Enforce the sentinel-safety contract :func:`gather_kv_pages` can
+    only document: every table entry covering a live key position must be
+    a real page id, so the clamped out-of-bounds garbage a sentinel
+    gathers is always masked by ``cache_len`` downstream.
+
+    Host-side (numpy) debug/test assertion — never call under jit.
+    page_table: (B, P) int; cache_len: (B,) valid tokens per slot;
+    ``new_tokens`` extends the check over the positions the current tick
+    scatters into (decode: 1, verify: γ+1), which must also land on real
+    pages. Raises AssertionError naming the first offending slot.
+    """
+    import numpy as np
+
+    table = np.asarray(page_table)
+    lens = np.asarray(cache_len)
+    covered = np.minimum(
+        -(-(lens + new_tokens) // page),            # ceil-div: pages live
+        table.shape[1])
+    pos = np.arange(table.shape[1])[None, :]        # (1, P)
+    bad = (table == sentinel) & (pos < covered[:, None])
+    if bad.any():
+        b = int(np.argwhere(bad.any(axis=1))[0, 0])
+        raise AssertionError(
+            f"sentinel page covers live positions: slot {b} has "
+            f"cache_len={int(lens[b])} (+{new_tokens} new) but table row "
+            f"{table[b].tolist()} holds sentinel {sentinel} inside the "
+            f"first {int(covered[b])} page(s) — gather_kv_pages would "
+            f"clamp it to unmasked garbage")
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, k_new, v_new,
@@ -167,19 +230,26 @@ def verify_attention(q, k_cache, v_cache, k_new, v_new,
     batch, g_len, q_heads, head_dim = q.shape
     kv_heads = k_cache.shape[2]
     group = q_heads // kv_heads
-    qg = q.reshape(batch, g_len, kv_heads, group, head_dim)
+    qg = q.reshape(batch, g_len, kv_heads, group,
+                   head_dim).astype(jnp.float32)
 
+    # same _snap rounding schedule as decode_attention_cached (f32
+    # end-to-end, explicit rounding points) so G=1 verify stays
+    # bit-identical to a decode step and the ragged kernel's verify
+    # variant can reproduce this path exactly under jit.
     scale = head_dim ** -0.5
-    scores = jnp.einsum("bskgd,btkd->bkgst", qg,
-                        k_cache.astype(q.dtype)).astype(jnp.float32) * scale
+    scores = _snap(jnp.einsum("bskgd,btkd->bkgst", qg,
+                              k_cache.astype(jnp.float32)),
+                   q.dtype) * scale
     if k_scale is not None:
         scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     valid = jnp.arange(k_cache.shape[1])[None, None, None, None, :] \
         < cache_len[:, None, None, None, None]
     scores = jnp.where(valid, scores, _NEG_INF)
     # the G new tokens attend each other causally (key u <= query s)
-    scores_new = jnp.einsum("bskgd,bukd->bkgsu", qg,
-                            k_new).astype(jnp.float32) * scale
+    scores_new = _snap(jnp.einsum("bskgd,bukd->bkgsu", qg,
+                                  k_new.astype(jnp.float32)),
+                       q.dtype) * scale
     causal = (jnp.arange(g_len)[None, :]
               <= jnp.arange(g_len)[:, None])            # (S, U)
     scores_new = jnp.where(causal[None, None, None], scores_new, _NEG_INF)
@@ -191,14 +261,15 @@ def verify_attention(q, k_cache, v_cache, k_new, v_new,
     if v_scale is not None:
         probs_cache = probs_cache \
             * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
-        out = jnp.einsum("bkgst,btkd->bskgd", probs_cache,
-                         v_cache.astype(jnp.float32)).astype(q.dtype)
     else:
-        out = jnp.einsum("bkgst,btkd->bskgd", probs_cache.astype(q.dtype),
-                         v_cache.astype(q.dtype))
-    out = out + jnp.einsum("bkgsu,bukd->bskgd", probs_new.astype(q.dtype),
-                           v_new)
-    return out.reshape(batch, g_len, q_heads, head_dim)
+        probs_cache = _snap(probs_cache, q.dtype)
+    out = _snap(jnp.einsum("bkgst,btkd->bskgd", probs_cache,
+                           v_cache.astype(jnp.float32)), q.dtype)
+    out_new = _snap(jnp.einsum("bkgsu,bukd->bskgd",
+                               _snap(probs_new, q.dtype),
+                               v_new.astype(jnp.float32)), q.dtype)
+    out = _snap(out + out_new, q.dtype)
+    return out.reshape(batch, g_len, q_heads, head_dim).astype(q.dtype)
 
 
 def paged_verify_attention(q, k_pages, v_pages, page_table, k_new, v_new,
@@ -250,33 +321,41 @@ def decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
     batch, _, q_heads, head_dim = q.shape
     kv_heads = k_cache.shape[2]
     group = q_heads // kv_heads
-    qg = q[:, 0].reshape(batch, kv_heads, group, head_dim)
+    qg = q[:, 0].reshape(batch, kv_heads, group,
+                         head_dim).astype(jnp.float32)
 
+    # f32 end-to-end with _snap at the points the low-precision
+    # formulation rounds (score einsums, normalized probs, value
+    # einsums, the final add) — same values as computing in q.dtype,
+    # but jit-stable and exactly reproducible by the ragged kernel.
     scale = head_dim ** -0.5
-    scores = jnp.einsum("bkgd,btkd->bkgt", qg,
-                        k_cache.astype(q.dtype)).astype(jnp.float32) * scale
+    scores = _snap(jnp.einsum("bkgd,btkd->bkgt", qg,
+                              k_cache.astype(jnp.float32)),
+                   q.dtype) * scale
     if k_scale is not None:
         scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, :]
     valid = jnp.arange(k_cache.shape[1])[None, None, None, :] \
         < cache_len[:, None, None, None]
     scores = jnp.where(valid, scores, _NEG_INF)
-    score_new = jnp.einsum("bkgd,bkd->bkg", qg,
-                           k_new).astype(jnp.float32)[..., None] * scale
+    score_new = _snap(jnp.einsum("bkgd,bkd->bkg", qg,
+                                 k_new.astype(jnp.float32)),
+                      q.dtype)[..., None] * scale
     scores = jnp.concatenate([scores, score_new], axis=-1)  # (B,K,G,T+1)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
     probs_cache = probs[..., :-1]
     if v_scale is not None:
         # int8 path: keep the probs * v_scale product in f32 through the
-        # cache V einsum — casting the scaled probs to bf16 first stacks
-        # bf16 mantissa loss on top of the int8 quantization error, and
-        # this path is the capacity (not speed) lever anyway.
+        # cache V einsum — snapping the scaled probs first stacks
+        # low-precision mantissa loss on top of the int8 quantization
+        # error, and this path is the capacity (not speed) lever anyway.
         probs_cache = probs_cache * v_scale.transpose(0, 2, 1)[:, :, None, :]
-        out = jnp.einsum("bkgt,btkd->bkgd", probs_cache,
-                         v_cache.astype(jnp.float32)).astype(q.dtype)
     else:
-        out = jnp.einsum("bkgt,btkd->bkgd", probs_cache.astype(q.dtype),
-                         v_cache.astype(q.dtype))
-    out = out + jnp.einsum("bkg,bkd->bkgd", probs[..., -1].astype(q.dtype),
-                           v_new)
-    return out.reshape(batch, 1, q_heads, head_dim)
+        probs_cache = _snap(probs_cache, q.dtype)
+    out = _snap(jnp.einsum("bkgt,btkd->bkgd", probs_cache,
+                           v_cache.astype(jnp.float32)), q.dtype)
+    out_new = _snap(jnp.einsum("bkg,bkd->bkgd",
+                               _snap(probs[..., -1], q.dtype),
+                               v_new.astype(jnp.float32)), q.dtype)
+    out = _snap(out + out_new, q.dtype)
+    return out.reshape(batch, 1, q_heads, head_dim).astype(q.dtype)

@@ -1,6 +1,12 @@
 """Pallas TPU kernels for the hot ops (see pallas_guide.md)."""
 
 from gofr_tpu.ops.pallas.decode_attention import flash_decode_attention
+from gofr_tpu.ops.pallas.fallback import resolve_interpret
 from gofr_tpu.ops.pallas.flash_attention import flash_attention
+from gofr_tpu.ops.pallas.ragged_paged_attention import (
+    ragged_paged_decode_attention, ragged_paged_verify_attention,
+    ragged_supported)
 
-__all__ = ["flash_attention", "flash_decode_attention"]
+__all__ = ["flash_attention", "flash_decode_attention",
+           "ragged_paged_decode_attention", "ragged_paged_verify_attention",
+           "ragged_supported", "resolve_interpret"]

@@ -172,14 +172,16 @@ def flash_decode_attention(q, k_cache, v_cache, k_new, v_new, cache_len,
     dense fallback. q (B,1,Hq,D); caches (B,Tmax,Hkv,D); k_new/v_new
     (B,Hkv,D); cache_len (B,) valid entries excluding the current token.
     Returns (B,1,Hq,D)."""
+    from gofr_tpu.ops.pallas.fallback import (decode_shapes_tileable,
+                                              resolve_interpret)
+
     t_max, head_dim = k_cache.shape[1], q.shape[3]
     q_heads = q.shape[2]
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    # call-time backend check (shared with the ragged kernel): tests that
+    # swap platforms between calls must not see a stale decision
+    interpret = resolve_interpret(interpret)
     block_k = min(block_k, t_max)
-    tileable = (t_max % block_k == 0 and head_dim % 128 == 0
-                and t_max >= 128 and q_heads % 8 == 0)
-    if not tileable:
+    if not decode_shapes_tileable(t_max, block_k, head_dim, q_heads):
         from gofr_tpu.ops.attention import decode_attention_cached
         return decode_attention_cached(q, k_cache, v_cache, k_new, v_new,
                                        cache_len)

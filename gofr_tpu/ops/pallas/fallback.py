@@ -1,0 +1,62 @@
+"""Shared fallback policy for the Pallas TPU kernels.
+
+Every kernel in this package ships with a pure-jnp reference formulation
+that stays the correctness oracle (ops/attention); the kernels fall back
+to it when the shapes miss TPU tiling or the process is not running on a
+TPU at all. Two rules keep that decision honest:
+
+- The backend is re-checked at **call time**, never cached at import
+  time: tests (and multi-backend processes) swap ``JAX_PLATFORMS``
+  between calls, and a stale import-time decision would pin interpret
+  mode — or worse, a compiled TPU kernel — across the swap.
+- Tiling support is split by mode: the compiled kernel needs real
+  Mosaic tiles (lane dim 128, sublane-aligned head counts), while
+  interpret mode only needs shapes the emulator can reshape cleanly —
+  so CPU tier-1 tests exercise the kernel's control flow on geometries
+  (tiny presets) the hardware tiles would reject.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["resolve_interpret", "decode_shapes_tileable",
+           "ragged_shapes_supported"]
+
+
+def resolve_interpret(interpret: Optional[bool]) -> bool:
+    """Resolve an ``interpret=None`` default to "not on a TPU", checked
+    at call time (a test that swaps platforms mid-process must not see a
+    stale decision). Explicit True/False passes through untouched."""
+    if interpret is not None:
+        return bool(interpret)
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def decode_shapes_tileable(t_max: int, block_k: int, head_dim: int,
+                           q_heads: int) -> bool:
+    """Dense flash-decode tiling predicate (ops/pallas/decode_attention):
+    the KV window must split into whole lane-aligned blocks and heads
+    must fill a sublane."""
+    return (t_max % block_k == 0 and head_dim % 128 == 0
+            and t_max >= 128 and q_heads % 8 == 0)
+
+
+def ragged_shapes_supported(head_dim: int, q_heads: int, kv_heads: int,
+                            page: int, interpret: bool) -> bool:
+    """Ragged-paged-attention support predicate.
+
+    Compiled mode needs Mosaic-tileable blocks: a 128-lane head_dim, a
+    sublane-filling q-head count, and a page deep enough to tile the KV
+    block. Interpret mode (the CPU tier-1 path) only needs the reshapes
+    inside the kernel to be exact — head_dim a whole number of 8-lanes —
+    so tiny test geometries run the kernel while a genuinely misaligned
+    head_dim still exercises the gather fallback on every backend.
+    """
+    if q_heads % kv_heads != 0 or page < 1:
+        return False
+    if interpret:
+        return head_dim % 8 == 0
+    return head_dim % 128 == 0 and q_heads % 8 == 0 and page % 16 == 0

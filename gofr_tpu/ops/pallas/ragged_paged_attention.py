@@ -1,0 +1,373 @@
+"""Fused ragged paged attention — one Pallas TPU kernel over
+variable-length page tables (ROADMAP top item, after "Ragged Paged
+Attention", arxiv 2604.15464).
+
+The gather formulation (ops/attention.paged_decode_attention) simulates
+raggedness: it materializes a dense ``(B, P*page)`` KV view per layer
+with ``P`` a static ladder rung, so every width rung is a separately
+compiled executable (graftcheck's GT003 page-width hazard class exists
+because of it) and HBM bandwidth is spent rebuilding views the kernel
+could walk in place. This kernel walks them in place:
+
+- Grid ``(slot, kv-page-block)``; the page table and per-slot fill ride
+  **scalar prefetch**, so each program's K/V BlockSpec index map reads
+  its slot's *actual* pool row directly from the table — no materialized
+  gather, no static width ladder, one executable for every fill level.
+- TWO-PHASE page walk for token identity: the page-block axis runs the
+  table twice. Phase 0 streams K only and finishes the softmax
+  statistics (max and normalizer in VMEM scratch); phase 1 re-derives
+  each block's scores, materializes the *final* per-position
+  probabilities, and accumulates P·V. A single-pass online-softmax
+  kernel is cheaper but renormalizes probabilities with correction
+  factors the gather oracle never applies — its probs are rounded to the
+  cache dtype *after* global normalization, and at bf16 that rounding
+  difference walks greedy decode off the oracle's token stream within a
+  few ticks. Phase 1 reproduces the oracle's rounding points exactly
+  (scores rounded at the einsum boundary, probs rounded post-
+  normalization, cache/new contributions added in cache dtype), so
+  kernel vs gather is bit-equal up to f32 sum-order noise that the
+  dtype rounding absorbs. Cost: K streams twice, V once (V's index map
+  parks on one row during phase 0 so no dead fetches) — still far below
+  the gather path, which writes AND reads a materialized (B, P·page)
+  copy of both K and V every layer.
+- Pages past the slot's fill are clamped to the last valid row in the
+  index map (the pipeline elides re-fetching an unchanged block) and
+  their compute is skipped with ``pl.when`` — sentinel page ids are
+  never dereferenced, which the tests assert by poisoning unreferenced
+  pages with NaN.
+- int8 pools dequantize **in-kernel** from the scale planes that live
+  beside the pages (k/v scaled to f32 before the dots — the same math
+  as the gather path's post-einsum score folding, without ever
+  materializing a converted cache copy).
+- The γ+1-token query variant (:func:`ragged_paged_verify_attention`)
+  backs speculative verify: G queries at positions ``cache_len + g``
+  attend the paged cache plus each other causally, so verify stops
+  paying prefill-shaped attention.
+
+Post-mortem context (ops/pallas/decode_attention): the dense flash
+prototype lost 5x *inside* the per-layer scan because each pallas_call
+is an opaque boundary to XLA's weight-prefetch pipeline. The economics
+here differ — this kernel *replaces* a per-layer HBM gather
+materialization instead of competing with a fused einsum — but the same
+rule applies: judge it on the full decode tick (bench.py
+``llama_ragged_attn``), never the standalone op. Off-TPU or on
+tiling-miss shapes it falls back to the gather formulation, which stays
+the correctness oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gofr_tpu.ops.pallas.fallback import (ragged_shapes_supported,
+                                          resolve_interpret)
+
+_NEG_INF = -1e30
+
+__all__ = ["ragged_paged_decode_attention", "ragged_paged_verify_attention",
+           "ragged_supported"]
+
+
+def ragged_supported(head_dim: int, q_heads: int, kv_heads: int, page: int,
+                     interpret: Optional[bool] = None) -> bool:
+    """Would these shapes run the fused kernel (vs the gather fallback)?
+    The engine's ``ragged_attn="auto"`` resolves through this."""
+    return ragged_shapes_supported(head_dim, q_heads, kv_heads, page,
+                                   resolve_interpret(interpret))
+
+
+def _ragged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, kn_ref, vn_ref,
+                   *rest, page: int, num_pi: int, kv_heads: int, group: int,
+                   g_len: int, int8: bool, sm_scale: float):
+    """One (slot, walk-step) program on the doubled page-block axis.
+
+    Steps ``[0, num_pi)`` are phase 0 (K only): accumulate the softmax
+    max and normalizer over the slot's live pages, then fold the G new
+    tokens' scores so the statistics are FINAL. Steps
+    ``[num_pi, 2*num_pi)`` are phase 1: re-derive each block's scores,
+    form the oracle's exact per-position probabilities (rounded to the
+    cache dtype after normalization, just like the gather path's
+    ``probs.astype(q.dtype)``), and accumulate P·V; the last step adds
+    the new tokens' contribution and writes the output. ``rest`` is
+    (ks, vs, out, acc, m, l) on int8 pools — the scale-plane blocks ride
+    the same index maps as their pages — and (out, acc, m, l) on bf16
+    pools, so bf16 never fetches a dead operand."""
+    from jax.experimental import pallas as pl
+
+    if int8:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+
+    b = pl.program_id(0)
+    pi = pl.program_id(1)
+    pj = lax.rem(pi, num_pi)                   # page index within a phase
+    length = len_ref[b]                        # valid tokens, excl. new
+    cdt = o_ref.dtype                          # the oracle's cache dtype
+
+    rp_bits = None
+    if jnp.finfo(cdt).bits < 32:
+        rp_bits = (jnp.finfo(cdt).nexp, jnp.finfo(cdt).nmant)
+
+    def _round(x):
+        # the gather oracle snaps to the cache dtype's precision at every
+        # materialization point (ops/attention._snap): mimic it with the
+        # same reduce_precision — an astype round-trip could be folded
+        # away by the compiler, silently moving the rounding points
+        # (identity at f32)
+        if rp_bits is None:
+            return x
+        return lax.reduce_precision(x, *rp_bits)
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def q_rows():
+        # (G, Hq, D) -> per-kv-head (G*group, D) row stacks; query g of
+        # kv-head h owns rows [g*group, (g+1)*group). UNSCALED: the
+        # oracle applies sm_scale after the (rounded) score einsum.
+        q = q_ref[0].astype(jnp.float32).reshape(g_len, kv_heads, group, -1)
+        return [q[:, h].reshape(g_len * group, -1) for h in range(kv_heads)]
+
+    def block_scores():
+        # per-kv-head dots unrolled in Python: Mosaic does not lower a
+        # batched dot_general with unequal non-contracting dims. Rounding
+        # order matches the oracle exactly: dot -> cache-dtype round ->
+        # * sm_scale -> (* k_scale on int8) -> length mask.
+        qh = q_rows()
+        k_blk = k_ref[0].astype(jnp.float32)       # (page, Hkv, D)
+        parts = []
+        for h in range(kv_heads):
+            s_h = _round(jnp.dot(qh[h], k_blk[:, h, :].T,
+                                 preferred_element_type=jnp.float32))
+            s_h = s_h * sm_scale                   # (G*grp, page)
+            if int8:
+                # fused dequant, oracle formulation: the int8 scores are
+                # exact through the rounded dot, and the per-vector scale
+                # folds into f32 AFTER — never a converted cache copy
+                s_h = s_h * ks_ref[0][:, h][None, :]
+            parts.append(s_h)
+        scores = jnp.concatenate(parts, axis=0)    # (rows, page)
+        pos = pj * page + lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        return jnp.where(pos < length, scores, _NEG_INF)
+
+    def new_scores():
+        # the G new tokens (positions length..length+G-1, causal among
+        # themselves: key u attends to query s iff u <= s); their K
+        # arrives unquantized even on int8 pools (oracle contract)
+        qh = q_rows()
+        k_new = kn_ref[0].astype(jnp.float32)      # (G, Hkv, D)
+        s_new = jnp.concatenate(
+            [_round(jnp.dot(qh[h], k_new[:, h, :].T,
+                            preferred_element_type=jnp.float32)) * sm_scale
+             for h in range(kv_heads)], axis=0)    # (rows, G)
+        q_pos = lax.broadcasted_iota(
+            jnp.int32, (g_len * group, g_len), 0) // group
+        u_pos = lax.broadcasted_iota(
+            jnp.int32, (g_len * group, g_len), 1)
+        causal = u_pos <= q_pos
+        return jnp.where(jnp.tile(causal, (kv_heads, 1)), s_new, _NEG_INF)
+
+    # -- phase 0: softmax statistics over the live pages ------------------
+    @pl.when(jnp.logical_and(pi < num_pi, pj * page < length))
+    def _stats_step():
+        scores = block_scores()
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = (l_prev * corr
+                    + jnp.exp(scores - m_new).sum(axis=-1, keepdims=True))
+
+    @pl.when(pi == num_pi - 1)
+    def _stats_finish():
+        # fold the new tokens' scores: m/l are FINAL after this step (the
+        # causal diagonal guarantees l >= 1, so phase 1 never divides by
+        # zero)
+        s_new = new_scores()
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_fin = jnp.maximum(m_prev, s_new.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_fin)
+        m_ref[:] = m_fin
+        l_ref[:] = (l_prev * corr
+                    + jnp.exp(s_new - m_fin).sum(axis=-1, keepdims=True))
+
+    # -- phase 1: oracle-identical probabilities, P·V accumulation --------
+    @pl.when(jnp.logical_and(pi >= num_pi, pj * page < length))
+    def _value_step():
+        p = jnp.exp(block_scores() - m_ref[:]) / l_ref[:]  # (rows, page)
+        if not int8:
+            p = _round(p)                      # probs.astype(q.dtype)
+        v_blk = v_ref[0].astype(jnp.float32)
+        p3 = p.reshape(kv_heads, g_len * group, page)
+        parts = []
+        for h in range(kv_heads):
+            ph = p3[h]
+            if int8:
+                # oracle int8 V path: normalized probs stay f32 and the
+                # per-vector scale folds in pre-einsum (precision over
+                # bandwidth — see decode_attention_cached)
+                ph = ph * vs_ref[0][:, h][None, :]
+            parts.append(jnp.dot(ph, v_blk[:, h, :],
+                                 preferred_element_type=jnp.float32))
+        acc_ref[:] += jnp.concatenate(parts, axis=0)       # (rows, D)
+
+    @pl.when(pi == 2 * num_pi - 1)
+    def _finish():
+        p_new = _round(jnp.exp(new_scores() - m_ref[:]) / l_ref[:])
+        v_new = vn_ref[0].astype(jnp.float32)      # (G, Hkv, D)
+        p3 = p_new.reshape(kv_heads, g_len * group, g_len)
+        pv = jnp.concatenate(
+            [jnp.dot(p3[h], v_new[:, h, :],
+                     preferred_element_type=jnp.float32)
+             for h in range(kv_heads)], axis=0)            # (rows, D)
+        # the oracle snaps the cache and new-token einsum outputs, adds
+        # them in f32 and snaps the sum (ops/attention._snap schedule)
+        out = _round(_round(acc_ref[:]) + _round(pv))      # (rows, D)
+        head_dim = out.shape[-1]
+        o_ref[0] = out.reshape(kv_heads, g_len, group, head_dim) \
+            .swapaxes(0, 1).reshape(g_len, kv_heads * group, head_dim) \
+            .astype(o_ref.dtype)
+
+
+def _pallas_ragged(q, k_pages, v_pages, page_table, k_new, v_new,
+                   cache_len, k_scale_pages, v_scale_pages,
+                   interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch, g_len, q_heads, head_dim = q.shape
+    num_pages, page, kv_heads, _ = k_pages.shape
+    group = q_heads // kv_heads
+    num_pi = page_table.shape[1]
+    int8 = k_scale_pages is not None
+    table = page_table.astype(jnp.int32)
+    lens = cache_len.astype(jnp.int32)
+
+    def _row(b, pj, table_ref, len_ref):
+        # scalar-prefetch table walk: fetch this slot's ACTUAL pool row.
+        # Clamp pj to the last page holding valid tokens (the pipeline
+        # elides re-fetching an unchanged row, so the dead tail of the
+        # table is never streamed), then clamp a sentinel id in-bounds —
+        # its compute is skipped by pl.when, never attended.
+        length = len_ref[b]
+        last = jnp.maximum(lax.div(length + page - 1, page) - 1, 0)
+        pid = table_ref[b, jnp.minimum(pj, last)]
+        return jnp.minimum(pid, num_pages - 1)
+
+    def k_index(b, pi, table_ref, len_ref):
+        # K streams in BOTH phases (scores are re-derived in phase 1)
+        return (_row(b, lax.rem(pi, num_pi), table_ref, len_ref), 0, 0, 0)
+
+    def v_index(b, pi, table_ref, len_ref):
+        # V is only read in phase 1; during phase 0 the map parks on the
+        # row phase 1 fetches first, so no dead V block is ever streamed
+        pj = jnp.where(pi >= num_pi, lax.rem(pi, num_pi), 0)
+        return (_row(b, pj, table_ref, len_ref), 0, 0, 0)
+
+    def ks_index(b, pi, table_ref, len_ref):
+        return k_index(b, pi, table_ref, len_ref)[:3]
+
+    def vs_index(b, pi, table_ref, len_ref):
+        return v_index(b, pi, table_ref, len_ref)[:3]
+
+    def q_index(b, pi, table_ref, len_ref):
+        return (b, 0, 0, 0)
+
+    kernel = functools.partial(
+        _ragged_kernel, page=page, num_pi=num_pi, kv_heads=kv_heads,
+        group=group, g_len=g_len, int8=int8, sm_scale=head_dim ** -0.5)
+    in_specs = [
+        pl.BlockSpec((1, g_len, q_heads, head_dim), q_index),
+        pl.BlockSpec((1, page, kv_heads, head_dim), k_index),
+        pl.BlockSpec((1, page, kv_heads, head_dim), v_index),
+        pl.BlockSpec((1, g_len, kv_heads, head_dim), q_index),
+        pl.BlockSpec((1, g_len, kv_heads, head_dim), q_index),
+    ]
+    operands = [q, k_pages, v_pages, k_new, v_new]
+    if int8:
+        in_specs += [pl.BlockSpec((1, page, kv_heads), ks_index),
+                     pl.BlockSpec((1, page, kv_heads), vs_index)]
+        operands += [k_scale_pages, v_scale_pages]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, 2 * num_pi),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, g_len, q_heads, head_dim), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((g_len * q_heads, head_dim), jnp.float32),
+            pltpu.VMEM((g_len * q_heads, 1), jnp.float32),
+            pltpu.VMEM((g_len * q_heads, 1), jnp.float32),
+        ],
+    )
+    compiler_params = None
+    if not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(table, lens, *operands)
+
+
+def ragged_paged_decode_attention(q, k_pages, v_pages, page_table, k_new,
+                                  v_new, cache_len, k_scale_pages=None,
+                                  v_scale_pages=None,
+                                  interpret: Optional[bool] = None
+                                  ) -> jnp.ndarray:
+    """Drop-in for ops.attention.paged_decode_attention with automatic
+    gather fallback. q (B,1,Hq,D); k_pages/v_pages (num_pages,page,Hkv,D);
+    page_table (B,P) int32 with ``num_pages`` the unallocated sentinel;
+    k_new/v_new (B,Hkv,D); cache_len (B,) valid tokens excluding the
+    current one; int8 pools pass the (num_pages,page,Hkv) scale planes.
+    Returns (B,1,Hq,D)."""
+    interpret = resolve_interpret(interpret)
+    _, _, q_heads, head_dim = q.shape
+    page, kv_heads = k_pages.shape[1], k_pages.shape[2]
+    if not ragged_shapes_supported(head_dim, q_heads, kv_heads, page,
+                                   interpret):
+        from gofr_tpu.ops.attention import paged_decode_attention
+        return paged_decode_attention(q, k_pages, v_pages, page_table,
+                                      k_new, v_new, cache_len,
+                                      k_scale_pages=k_scale_pages,
+                                      v_scale_pages=v_scale_pages)
+    return _pallas_ragged(q, k_pages, v_pages, page_table,
+                          k_new[:, None], v_new[:, None], cache_len,
+                          k_scale_pages, v_scale_pages, interpret)
+
+
+def ragged_paged_verify_attention(q, k_pages, v_pages, page_table, k_new,
+                                  v_new, cache_len, k_scale_pages=None,
+                                  v_scale_pages=None,
+                                  interpret: Optional[bool] = None
+                                  ) -> jnp.ndarray:
+    """γ+1-token variant backing speculative verify: drop-in for
+    ops.attention.paged_verify_attention. q (B,G,Hq,D); k_new/v_new
+    (B,G,Hkv,D) — query g sits at position ``cache_len + g``, attends
+    the paged cache (< cache_len) plus the new tokens causally
+    (u <= g). Falls back to the gather formulation exactly like the
+    decode variant. Returns (B,G,Hq,D)."""
+    interpret = resolve_interpret(interpret)
+    _, _, q_heads, head_dim = q.shape
+    page, kv_heads = k_pages.shape[1], k_pages.shape[2]
+    if not ragged_shapes_supported(head_dim, q_heads, kv_heads, page,
+                                   interpret):
+        from gofr_tpu.ops.attention import paged_verify_attention
+        return paged_verify_attention(q, k_pages, v_pages, page_table,
+                                      k_new, v_new, cache_len,
+                                      k_scale_pages=k_scale_pages,
+                                      v_scale_pages=v_scale_pages)
+    return _pallas_ragged(q, k_pages, v_pages, page_table, k_new, v_new,
+                          cache_len, k_scale_pages, v_scale_pages,
+                          interpret)

@@ -290,6 +290,7 @@ class GenerationEngine:
                  kv_pool_bytes: Optional[int] = None,
                  kv_page_reserve: Optional[int] = None,
                  page_pool=None,
+                 ragged_attn: str = "auto",
                  model_module=None,
                  model_name: str = "generate",
                  draft_cfg=None, draft_params=None,
@@ -517,6 +518,42 @@ class GenerationEngine:
         else:
             self.cache = jax.device_put(
                 llama.init_cache(cfg, max_slots, self.max_len))
+        # fused ragged paged attention (ISSUE 13): "auto" activates the
+        # Pallas kernel on TPU when the KV geometry tiles (off-TPU the
+        # gather formulation is at least as fast and stays the oracle);
+        # "on" forces it everywhere — interpret mode off-TPU — which is
+        # how CPU tier-1 tests and benches exercise the kernel path.
+        # Active ragged retires the gather-width ladder: page tables ship
+        # whole, so decode executables key on (k, sampled) alone.
+        self.ragged_attn = str(ragged_attn).lower()
+        if self.ragged_attn not in ("auto", "on", "off"):
+            raise ValueError(
+                f"ragged_attn must be auto|on|off, got {ragged_attn!r}")
+        if self.ragged_attn == "on" and not self.paged:
+            raise ValueError("ragged_attn='on' requires paged_kv=True "
+                             "(the kernel walks the page pool)")
+        self._ragged = False
+        if self.paged and self.ragged_attn != "off":
+            import inspect
+
+            from gofr_tpu.ops.pallas import (ragged_supported,
+                                             resolve_interpret)
+            step = self._llama.decode_step_paged
+            has_kwarg = "ragged" in inspect.signature(step).parameters
+            if not has_kwarg:
+                if self.ragged_attn == "on":
+                    raise ValueError(
+                        "ragged_attn='on': the model module's "
+                        "decode_step_paged does not take ragged=")
+            else:
+                interp = resolve_interpret(None)
+                supported = ragged_supported(
+                    cfg.head_dim, cfg.n_heads, cfg.n_kv_heads,
+                    self.kv_page, interpret=interp)
+                if self.ragged_attn == "on":
+                    self._ragged = True
+                else:
+                    self._ragged = (not interp) and supported
         self.cache_len = jnp.zeros((max_slots,), jnp.int32)
         self.last_token = jnp.zeros((max_slots,), jnp.int32)
         # per-slot sampling state (ops/sampling): scattered at admission,
@@ -943,12 +980,16 @@ class GenerationEngine:
         shared page pool through a ``(max_slots, pw)`` page-table slice
         instead of indexing a dense cache row. ``pw`` is the page-gather
         width — the window rung demoted to ``ceil(rung / kv_page)`` table
-        columns, a static ladder value. Inactive rows scatter to the
-        sentinel page id and drop."""
+        columns, a static ladder value. With the ragged kernel active,
+        ``pw`` is always ``pages_per_slot`` (the ladder is retired) and
+        the step attends pool pages in place — one executable per
+        (k, sampled) family. Inactive rows scatter to the sentinel page
+        id and drop."""
         fn = self._decode_paged_fns.get((k_steps, sampled, pw))
         if fn is None:
             jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
                                     self.cfg)
+            step_kw = {"ragged": True} if self._ragged else {}
             from jax import lax
 
             if not sampled:
@@ -957,7 +998,7 @@ class GenerationEngine:
                         token, pool, cache_len = carry
                         logits, pool2, new_len = llama.decode_step_paged(
                             params, cfg, token, pool, table, cache_len,
-                            active)
+                            active, **step_kw)
                         next_token = logits.argmax(axis=-1).astype(
                             token.dtype)
                         new_len = jnp.where(active, new_len, cache_len)
@@ -978,7 +1019,7 @@ class GenerationEngine:
                         token, pool, cache_len, keys = carry
                         logits, pool2, new_len = llama.decode_step_paged(
                             params, cfg, token, pool, table, cache_len,
-                            active)
+                            active, **step_kw)
                         next_token, new_keys = sample_batch(
                             logits, temps, top_ks, top_ps, keys)
                         next_token = next_token.astype(token.dtype)
@@ -1099,6 +1140,7 @@ class GenerationEngine:
         if fn is None:
             jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
                                     self.cfg)
+            step_kw = {"ragged": True} if self._ragged else {}
             from jax import lax
 
             if not sampled:
@@ -1110,7 +1152,7 @@ class GenerationEngine:
                         token, pool, cache_len = carry
                         logits, pool2, new_len = llama.decode_step_paged(
                             params, cfg, token, pool, table, cache_len,
-                            active)
+                            active, **step_kw)
                         next_token = (logits + bias).argmax(axis=-1).astype(
                             token.dtype)
                         new_len = jnp.where(active, new_len, cache_len)
@@ -1134,7 +1176,7 @@ class GenerationEngine:
                         token, pool, cache_len, keys = carry
                         logits, pool2, new_len = llama.decode_step_paged(
                             params, cfg, token, pool, table, cache_len,
-                            active)
+                            active, **step_kw)
                         next_token, new_keys = sample_batch(
                             logits + bias, temps, top_ks, top_ps, keys)
                         next_token = next_token.astype(token.dtype)
@@ -1279,6 +1321,7 @@ class GenerationEngine:
         if fn is None:
             jax, jnp, llama, cfg = (self._jax, self._jnp, self._llama,
                                     self.cfg)
+            step_kw = {"ragged": True} if self._ragged else {}
             dcfg = self.draft_cfg
             from jax import lax
 
@@ -1314,7 +1357,7 @@ class GenerationEngine:
                     [last_token[:, None], draft_tokens], axis=1)
                 t_logits, pool = llama.verify_step_paged(
                     params, cfg, verify_tokens, pool, table, cache_len,
-                    active)
+                    active, **step_kw)
                 out, accepts, carry = speculative_accept(
                     t_logits, q_logp, draft_tokens, temps, top_ks, top_ps,
                     accept_keys)
@@ -1347,10 +1390,27 @@ class GenerationEngine:
 
     def _pick_page_width(self, rung: Optional[int]) -> int:
         """Window rung -> page-gather width (table columns). None (full
-        window) gathers every column."""
-        if rung is None:
+        window) gathers every column.
+
+        With the ragged kernel active the ladder is retired: the kernel
+        walks only each slot's live pages via scalar prefetch, so a
+        narrower table buys nothing — every tick ships the full-width
+        table and the executable set collapses to one per (k, γ) family
+        (the GT003 recompile class the rungs existed to bound)."""
+        if self._ragged or rung is None:
             return self.pages_per_slot
         return min(self.pages_per_slot, -(-rung // self.kv_page))
+
+    @property
+    def attn_path(self) -> str:
+        """Which decode-attention formulation ticks run: ``ragged``
+        (fused Pallas kernel over pool pages), ``gather`` (paged KV
+        through the materialized gather view), or ``dense`` (per-slot
+        cache rows). Reported per tick via
+        ``app_tpu_attn_kernel_total{path=...}`` and in statusz/xlaz."""
+        if not self.paged:
+            return "dense"
+        return "ragged" if self._ragged else "gather"
 
     def _startup_window_rungs(self, ks: List[int]) -> List[Optional[int]]:
         """Window rungs reachable right after startup: every rung up to and
@@ -2256,6 +2316,8 @@ class GenerationEngine:
             pool["pages_per_slot"] = self.pages_per_slot
             pool["page_stalls"] = self._page_stalls
             pool["deferred_requests"] = len(self._overflow)
+            pool["attn_path"] = self.attn_path
+            pool["ragged_attn"] = self.ragged_attn
             out["kv_pool"] = pool
         if self.spec:
             rate = (self._spec_accepted / self._spec_proposed
@@ -2415,6 +2477,7 @@ class GenerationEngine:
                              for s in self._slots if s.active)
             kv_cache = {
                 "paged": True,
+                "attn_path": self.attn_path,
                 "max_slots": self.max_slots,
                 "max_len": self.max_len,
                 "page_tokens": self.kv_page,
@@ -2487,11 +2550,17 @@ class GenerationEngine:
         if self.paged:
             # the page-gather width ladder is the paged path's analogue of
             # the attention-window ladder: one decode executable per
-            # (k, sampled, width), width always ladder-derived
+            # (k, sampled, width), width always ladder-derived. With the
+            # ragged kernel active the set collapses to the single
+            # full-table width — the width-rung recompile class is gone.
             out["paged_kv"] = {
                 "page_tokens": self.kv_page,
+                "attn_path": self.attn_path,
+                "ragged_attn": self.ragged_attn,
                 "gather_widths": sorted({self._pick_page_width(w)
                                          for w in self._window_ladder}),
+                "decode_executables": sorted(
+                    str(key) for key in self._decode_paged_fns),
                 "pool": self._pool.stats(),
             }
         if self.spec:
@@ -3531,6 +3600,9 @@ class GenerationEngine:
             self.metrics.set_gauge(
                 "app_tpu_attention_window",
                 float(window or self.max_len), model=self.model_name)
+            self.metrics.increment_counter(
+                "app_tpu_attn_kernel_total", model=self.model_name,
+                path=self.attn_path)
             if self.paged:
                 held = sum(len(s.nodes) + len(s.pages)
                            for _, s in eligible)
@@ -3622,6 +3694,9 @@ class GenerationEngine:
                 model=self.model_name)
             self.metrics.set_gauge("app_tpu_spec_gamma", float(g),
                                    model=self.model_name)
+            self.metrics.increment_counter(
+                "app_tpu_attn_kernel_total", model=self.model_name,
+                path=self.attn_path)
 
         def fetch(pair=pair):
             return np.asarray(pair[0]), np.asarray(pair[1])

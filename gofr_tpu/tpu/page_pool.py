@@ -189,6 +189,25 @@ class PagePool:
                     self._free.append(pid)
         self._set_gauges()
 
+    @staticmethod
+    def pad_table(table, block: int, sentinel: int):
+        """Pad a host page table's width to a multiple of ``block`` with
+        sentinel entries (ragged-paged-attention export: the Pallas
+        kernel walks the table in page blocks, so its width must tile;
+        the sentinel tail is skipped by the kernel's length guard exactly
+        like any other dead entry). Returns the input unchanged when the
+        width already tiles. table: (B, P) int32 ndarray."""
+        import numpy as np
+
+        width = table.shape[1]
+        block = max(int(block), 1)
+        pad = (-width) % block
+        if pad == 0:
+            return table
+        return np.concatenate(
+            [table, np.full((table.shape[0], pad), sentinel,
+                            table.dtype)], axis=1)
+
     def note_writes(self, pages: int) -> None:
         """Count page-rows an owner's scatter actually wrote (sentinel
         entries excluded) — the zero-copy-admission proof reads this."""

@@ -29,6 +29,10 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/batch_lane_smoke.py || ex
 # digest, one live mid-stream migration (token identity, zero
 # re-prefill), one forced autoscale step
 timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || exit 1
+# ragged paged attention smoke: greedy token identity dense vs gather vs
+# the fused Pallas kernel (interpret mode), width-ladder retirement in
+# the ledger, sentinel pages never dereferenced (NaN poisoning)
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/ragged_attn_smoke.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

@@ -46,3 +46,12 @@ def ladder_width_upload(table, pages):
     # count, so the executable set is bounded by the ladder
     pw = next(w for w in _PAGE_WIDTHS if w >= len(pages))
     return jnp.asarray(table[:, :pw])
+
+
+def scalar_prefetch_table_upload(table, lens):
+    # ragged-attention idiom: the scalar-prefetch operands are the FULL
+    # fixed-width page table and the per-slot lengths — no live-count
+    # slice bound anywhere, so the executable set is one per geometry.
+    # The kernel skips dead entries via its in-kernel length guard
+    # instead of the host shrinking the upload.
+    return jnp.asarray(table), jnp.asarray(lens)

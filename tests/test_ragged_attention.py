@@ -1,0 +1,320 @@
+"""Fused ragged paged attention (ISSUE 13): one Pallas kernel over
+variable-length page tables.
+
+The load-bearing contracts, in order:
+
+1. TOKEN IDENTITY — the kernel's output is bit-equal to the gather
+   formulation (the correctness oracle) for every fill pattern, bf16
+   and int8, decode and the γ+1 verify variant, eager AND jitted. The
+   oracle itself is made jit-stable by explicit ``lax.reduce_precision``
+   rounding points (ops/attention._snap), which the kernel reproduces.
+2. SENTINEL SKIP — sentinel / dead-tail table entries are never
+   dereferenced: NaN-poisoning every unreferenced page must not perturb
+   the output (the gather path merely masks *scores*, so it cannot make
+   this guarantee — ``0 * NaN`` poisons V; the kernel's ``pl.when``
+   block skip can, and this test pins it).
+3. LADDER RETIREMENT — with ragged active the engine compiles ONE
+   decode executable per (steps, sampled) family: no per-width entries
+   in the ledger, gather_widths collapses to the full table width.
+4. FALLBACK — unsupported geometry falls back to the gather
+   formulation at call time and stays bit-identical by construction.
+
+All tests run the kernel in Pallas interpret mode on CPU (tier-1).
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.ops.attention import (check_sentinel_masked,
+                                    paged_decode_attention,
+                                    paged_verify_attention)
+from gofr_tpu.ops.pallas import (ragged_paged_decode_attention,
+                                 ragged_paged_verify_attention,
+                                 ragged_supported)
+from gofr_tpu.tpu.generate import GenerationEngine, Sampling
+from gofr_tpu.tpu.page_pool import PagePool
+
+NUM_PAGES, PAGE, HKV, HQ, D, P = 12, 16, 2, 4, 16, 4
+SENTINEL = NUM_PAGES
+
+
+def _scenario(cache_lens, g_len=1, int8=False, head_dim=D, seed=0):
+    """Pool leaves + a page table covering each slot's cache_len (pages
+    allocated bottom-up, page NUM_PAGES-1 deliberately never used — it
+    is the kernel's clamp target for sentinel entries)."""
+    keys = jax.random.split(jax.random.PRNGKey(seed), 8)
+    B = len(cache_lens)
+    shape = (NUM_PAGES, PAGE, HKV, head_dim)
+    if int8:
+        k_pages = jax.random.randint(keys[0], shape, -127, 128, jnp.int8)
+        v_pages = jax.random.randint(keys[1], shape, -127, 128, jnp.int8)
+        scales = dict(
+            k_scale_pages=jax.random.uniform(
+                keys[5], shape[:-1], jnp.float32, 0.01, 0.03),
+            v_scale_pages=jax.random.uniform(
+                keys[6], shape[:-1], jnp.float32, 0.01, 0.03))
+    else:
+        k_pages = jax.random.normal(keys[0], shape, jnp.float32) \
+            .astype(jnp.bfloat16)
+        v_pages = jax.random.normal(keys[1], shape, jnp.float32) \
+            .astype(jnp.bfloat16)
+        scales = {}
+    q = jax.random.normal(keys[2], (B, g_len, HQ, head_dim),
+                          jnp.float32).astype(jnp.bfloat16)
+    k_new = jax.random.normal(keys[3], (B, g_len, HKV, head_dim),
+                              jnp.float32).astype(jnp.bfloat16)
+    v_new = jax.random.normal(keys[4], (B, g_len, HKV, head_dim),
+                              jnp.float32).astype(jnp.bfloat16)
+    if g_len == 1:
+        k_new, v_new = k_new[:, 0], v_new[:, 0]
+    table = np.full((B, P), SENTINEL, np.int32)
+    nxt = 0
+    for b, n in enumerate(cache_lens):
+        for col in range(-(-n // PAGE)):
+            table[b, col] = nxt
+            nxt += 1
+    assert nxt < NUM_PAGES - 1          # keep the clamp target unused
+    return (q, k_pages, v_pages, jnp.asarray(table), k_new, v_new,
+            jnp.asarray(cache_lens, jnp.int32)), scales, table
+
+
+FILLS = [0, 5, P * PAGE, 17]            # empty / one partial / max / mixed
+
+
+# -- tentpole: bit-identity with the gather oracle ---------------------------
+
+def test_decode_identity_vs_gather_fill_patterns():
+    args, _, _ = _scenario(FILLS)
+    oracle = paged_decode_attention(*args)
+    out = ragged_paged_decode_attention(*args)
+    assert out.dtype == oracle.dtype
+    assert bool((out == oracle).all())
+
+
+def test_decode_identity_under_jit():
+    """The oracle's rounding points are explicit (reduce_precision), so
+    jit cannot fold them away: eager == jit == kernel, all four ways."""
+    args, _, _ = _scenario(FILLS)
+    eager = paged_decode_attention(*args)
+    jitted = jax.jit(paged_decode_attention)(*args)
+    ragged = jax.jit(ragged_paged_decode_attention)(*args)
+    assert bool((eager == jitted).all())
+    assert bool((jitted == ragged).all())
+
+
+def test_decode_identity_int8_fused_dequant():
+    args, scales, _ = _scenario([5, 33, 64], int8=True)
+    oracle = paged_decode_attention(*args, **scales)
+    out = ragged_paged_decode_attention(*args, **scales)
+    assert bool((out == oracle).all())
+
+
+def test_verify_identity_gamma_plus_one():
+    """γ+1-token verify variant: causal among the new tokens, same
+    rounding schedule — bit-equal to paged_verify_attention."""
+    args, _, _ = _scenario([0, 7, 40], g_len=3)
+    oracle = paged_verify_attention(*args)
+    out = ragged_paged_verify_attention(*args)
+    assert bool((out == oracle).all())
+
+
+def test_verify_identity_int8():
+    args, scales, _ = _scenario([9, 21], g_len=2, int8=True)
+    oracle = paged_verify_attention(*args, **scales)
+    out = ragged_paged_verify_attention(*args, **scales)
+    assert bool((out == oracle).all())
+
+
+# -- sentinel skip guarantee -------------------------------------------------
+
+def test_sentinel_pages_never_dereferenced():
+    """NaN-poison every page no table row references (including the
+    clamp target NUM_PAGES-1): the kernel's output must not move. The
+    gather oracle cannot pass this — its clamp gathers the poisoned
+    page and ``0 * NaN`` rides through the V einsum — which is exactly
+    why the kernel's ``pl.when`` skip is the stronger contract."""
+    args, _, table = _scenario([5, 0, 37])
+    clean = ragged_paged_decode_attention(*args)
+    q, k_pages, v_pages, table_dev, k_new, v_new, cache_len = args
+    live = set(table[table != SENTINEL].tolist())
+    dead = [p for p in range(NUM_PAGES) if p not in live]
+    assert NUM_PAGES - 1 in dead
+    poison = np.asarray(k_pages, np.float32)
+    poison[dead] = np.nan
+    k_poison = jnp.asarray(poison).astype(k_pages.dtype)
+    poison = np.asarray(v_pages, np.float32)
+    poison[dead] = np.nan
+    v_poison = jnp.asarray(poison).astype(v_pages.dtype)
+    out = ragged_paged_decode_attention(
+        q, k_poison, v_poison, table_dev, k_new, v_new, cache_len)
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+    assert bool((out == clean).all())
+
+
+def test_check_sentinel_masked_contract():
+    """The gather path's safety assertion: sentinel entries inside the
+    covered prefix (live tokens + the new token) are a table-corruption
+    bug, sentinel tails are fine."""
+    table = np.full((2, P), SENTINEL, np.int32)
+    table[0, :2] = [0, 1]
+    table[1, :1] = [2]
+    check_sentinel_masked(table, np.array([17, 3]), PAGE, SENTINEL)
+    bad = table.copy()
+    bad[0, 1] = SENTINEL                # covered by cache_len=17
+    with pytest.raises(AssertionError):
+        check_sentinel_masked(bad, np.array([17, 3]), PAGE, SENTINEL)
+
+
+def test_pad_table_tiles_with_sentinel():
+    table = np.arange(6, dtype=np.int32).reshape(2, 3)
+    padded = PagePool.pad_table(table, 4, SENTINEL)
+    assert padded.shape == (2, 4)
+    assert (padded[:, 3] == SENTINEL).all()
+    assert PagePool.pad_table(padded, 4, SENTINEL) is padded
+
+
+# -- fallback ----------------------------------------------------------------
+
+def test_fallback_on_misaligned_head_dim():
+    """head_dim=12 misses the interpret-mode tiling (not a multiple of
+    8): the ragged entry point must fall back to the gather formulation
+    and stay bit-identical by construction."""
+    assert not ragged_supported(12, HQ, HKV, PAGE, interpret=True)
+    args, _, _ = _scenario([5, 33], head_dim=12)
+    oracle = paged_decode_attention(*args)
+    out = ragged_paged_decode_attention(*args)
+    assert bool((out == oracle).all())
+
+
+def test_ragged_supported_predicate():
+    assert ragged_supported(16, 4, 2, 16, interpret=True)
+    assert ragged_supported(128, 8, 2, 16, interpret=False)
+    assert not ragged_supported(64, 8, 2, 16, interpret=False)   # hd % 128
+    assert not ragged_supported(16, 5, 2, 16, interpret=True)    # hq % hkv
+
+
+# -- engine integration ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _make_engine(cfg, params, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    engine = GenerationEngine(cfg, params, logger=container.logger,
+                              metrics=container.metrics, **kwargs)
+    return engine, container
+
+
+async def _serve(engine, prompts, budget=6, sampling=None):
+    await engine.start()
+    try:
+        outs = []
+        for prompt in prompts:
+            outs.append(await asyncio.wait_for(
+                engine.generate(prompt, max_new_tokens=budget,
+                                sampling=sampling), 60.0))
+        return outs
+    finally:
+        await engine.stop()
+
+
+def test_engine_greedy_identity_and_ladder_retirement(setup):
+    """The acceptance criterion: identical greedy streams dense vs
+    gather vs ragged — and with ragged active the per-width decode
+    executable class is gone (one (steps, sampled) family, gather
+    width pinned at the full table width)."""
+    cfg, params = setup
+    prompts = [[1, 2, 3, 4, 5], list(range(1, 11)), [9, 8, 7]]
+
+    dense = asyncio.run(_serve(_make_engine(cfg, params)[0], prompts))
+    g_eng, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                            ragged_attn="off")
+    gather = asyncio.run(_serve(g_eng, prompts))
+    r_eng, container = _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                                    ragged_attn="on")
+    ragged = asyncio.run(_serve(r_eng, prompts))
+    assert gather == dense
+    assert ragged == dense
+
+    assert g_eng.attn_path == "gather" and r_eng.attn_path == "ragged"
+    widths = r_eng.xlaz()["paged_kv"]["gather_widths"]
+    assert widths == [r_eng.pages_per_slot]          # ladder collapsed
+    assert len(g_eng.xlaz()["paged_kv"]["gather_widths"]) >= 1
+    # no per-width decode executables: every key carries the same
+    # (full-table) gather width
+    keys = r_eng.xlaz()["paged_kv"]["decode_executables"]
+    assert keys and len({k.rstrip(")").split(", ")[-1] for k in keys}) == 1
+    served = container.metrics.value("app_tpu_attn_kernel_total",
+                                     model=r_eng.model_name, path="ragged")
+    assert served and served > 0
+
+
+def test_engine_seeded_sampling_identity(setup):
+    cfg, params = setup
+    prompts = [[1, 2, 3, 4, 5], [7, 7, 7]]
+    sampling = Sampling(temperature=0.8, top_k=20, seed=7)
+    gather = asyncio.run(_serve(
+        _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                     ragged_attn="off")[0], prompts, sampling=sampling))
+    ragged = asyncio.run(_serve(
+        _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                     ragged_attn="on")[0], prompts, sampling=sampling))
+    assert ragged == gather
+
+
+def test_engine_prefix_hit_and_miss_identity(setup):
+    """Prefix-cache hits admit via table entries (zero-copy); decode
+    over adopted pages must still match the dense reference stream."""
+    cfg, params = setup
+    shared = list(range(1, 9))
+    prompts = [shared + [50 + i] for i in range(2)]
+    prompts = prompts + prompts          # second wave hits
+    ref = asyncio.run(_serve(_make_engine(cfg, params)[0], prompts))
+    engine, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                             prefix_cache=True, ragged_attn="on")
+    out = asyncio.run(_serve(engine, prompts))
+    assert out == ref
+    lookups = engine.stats()["prefix_cache"]["lookups"]
+    assert lookups["hit"] + lookups["partial"] >= 2
+
+
+def test_engine_int8_identity(setup):
+    import dataclasses
+    cfg, _ = setup
+    cfg8 = dataclasses.replace(cfg, kv_int8=True)
+    params = llama.init(cfg8, jax.random.PRNGKey(0))
+    prompts = [[1, 2, 3, 4, 5], [4, 4, 8, 1]]
+    gather = asyncio.run(_serve(
+        _make_engine(cfg8, params, paged_kv=True, kv_page=4,
+                     ragged_attn="off")[0], prompts, budget=4))
+    ragged = asyncio.run(_serve(
+        _make_engine(cfg8, params, paged_kv=True, kv_page=4,
+                     ragged_attn="on")[0], prompts, budget=4))
+    assert ragged == gather
+
+
+def test_ragged_attn_knob_validation(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError):
+        _make_engine(cfg, params, ragged_attn="on")      # needs paged_kv
+    with pytest.raises(ValueError):
+        _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                     ragged_attn="sometimes")
+    # auto off-TPU resolves to the gather path (interpret mode is for
+    # tests that opt in with "on", not production auto-selection)
+    engine, _ = _make_engine(cfg, params, paged_kv=True, kv_page=4,
+                             ragged_attn="auto")
+    assert engine.attn_path == "gather"
