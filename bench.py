@@ -168,6 +168,19 @@ REGRESSION_NOTES = {
         "pack/chunk + adopt on the host (no network priced) — tracks "
         "payload pages and host copy bandwidth, swings with host load "
         "on the CPU bench container"),
+    "llama_chaos_goodput_ratio": (
+        "new in r14 (chaos plane): chaos-arm tok/s over control tok/s "
+        "with one seeded mid-stream decode-replica kill per request — "
+        "the throughput tax of resumable decode (re-prefill of "
+        "prompt+emitted on the resume target); read only alongside the "
+        "same run's exactly_once and pages_restored flags, a faster "
+        "ratio that breaks either is a regression"),
+    "llama_chaos_resume_downtime_ms": (
+        "new in r14: median largest inter-token stall across healed "
+        "streams — re-admission + re-prefill on the resume target, no "
+        "network or failure-detection latency priced; compare against "
+        "max_gap_ms_control from the SAME run, swings with host load "
+        "on the CPU bench container"),
     "llama_batch_lane_tok_s_soaked": (
         "new in r11 (async batch lane): batch tokens the pub/sub lane "
         "completed during the interactive window / that window's wall "
@@ -228,6 +241,9 @@ _LEDGER_PATHS = {
                                       "prefix_hit_rate_affinity"),
     "llama_fleet_migration_downtime_ms": ("llama_fleet", "migration",
                                           "downtime_ms"),
+    "llama_chaos_goodput_ratio": ("llama_chaos", "goodput_ratio"),
+    "llama_chaos_resume_downtime_ms": ("llama_chaos",
+                                       "resume_downtime_ms"),
     "llama_batch_lane_tok_s_soaked": ("llama_batch_lane",
                                       "batch_tok_s_soaked"),
     "llama_batch_lane_interactive_ratio": ("llama_batch_lane",
@@ -311,6 +327,7 @@ def main() -> None:
     llama_spec = _llama_speculative_bench(on_tpu)
     llama_disagg = _llama_disagg_bench(on_tpu)
     llama_fleet = _llama_fleet_bench(on_tpu)
+    llama_chaos = _llama_chaos_bench(on_tpu)
     multi_model = _multi_model_bench(on_tpu)
     llama_batch_lane = _llama_batch_lane_bench(on_tpu)
     llama7b = _llama7b_int8_bench(on_tpu)
@@ -335,6 +352,7 @@ def main() -> None:
         "llama_speculative": llama_spec,
         "llama_disagg": llama_disagg,
         "llama_fleet": llama_fleet,
+        "llama_chaos": llama_chaos,
         "multi_model": multi_model,
         "llama_batch_lane": llama_batch_lane,
         "llama7b_int8": llama7b,
@@ -1808,6 +1826,164 @@ def _llama_fleet_bench(on_tpu: bool):
                  "compare arms within this run, not across rounds; "
                  "migration downtime is export + wire + adopt on the "
                  "host, no network priced"),
+    }
+
+
+def _llama_chaos_bench(on_tpu: bool):
+    """Chaos plane (docs/tpu/model-serving.md "Failure semantics"): what
+    a mid-stream decode-replica death actually costs the client. Two
+    arms on an identical 3-replica in-proc fleet and workload: the
+    CONTROL arm streams every request undisturbed; the CHAOS arm arms a
+    seeded ``crash_mid_decode`` plan per request (nth-token varies
+    across requests so the crash lands at different decode depths) and
+    lets the router's resumable-decode path heal each one. Priced:
+
+    - ``goodput_ratio`` — chaos-arm tok/s over control tok/s, the
+      steady-state throughput tax of recovery (re-prefill of
+      prompt+emitted on the resume target rides inside the timed
+      window);
+    - ``resume_downtime_ms`` — median over requests of the largest
+      inter-token gap, i.e. the stall the client saw around the crash
+      (the control arm's ``max_gap_ms`` is the no-fault baseline for
+      the same statistic);
+    - ``exactly_once`` — every healed stream delivers its full budget
+      with the pre-crash prefix matching the control arm exactly (no
+      duplicated, no missing token index), and every page pool drains
+      back to its free-list baseline. Those are the acceptance bar; a
+      fast recovery that corrupts a stream or leaks pages is a
+      regression, not a win.
+
+    ``identical_streams`` counts full token-for-token matches. It can
+    sit below ``requests`` without a bug: the resume re-prefills
+    prompt+emitted, and when two logits are EXACTLY tied (the tiny
+    bf16 bench model produces real ties) the prefill and decode paths
+    may break the argmax differently — identity is guaranteed in exact
+    arithmetic, prefix identity plus full budget is the hard
+    invariant."""
+    import time
+
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu import faults
+    from gofr_tpu.tpu.cluster import ROLE_BOTH, ClusterRegistry, InProcTransport
+    from gofr_tpu.tpu.fleet import FleetRouter
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    if on_tpu:
+        preset, max_len, buckets, page, slots = (
+            "small", 512, (64, 128), 32, 8)
+        prompt_len = 24
+    else:
+        preset, max_len, buckets, page, slots = "tiny", 64, (8, 16), 4, 4
+        prompt_len = 6
+    cfg = llama.config(preset)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    budget, n_requests = 12, 6
+    prompts = [[(13 * i + 5 * j) % 250 + 1 for j in range(prompt_len)]
+               for i in range(n_requests)]
+
+    def build():
+        container = new_mock_container()
+        return GenerationEngine(
+            cfg, params, max_slots=slots, max_len=max_len,
+            prompt_buckets=buckets, kv_page=page, paged_kv=True,
+            steps_per_tick=4,
+            logger=container.logger, metrics=container.metrics)
+
+    async def arm(chaos):
+        engines = {name: build() for name in ("d0", "d1", "d2")}
+        cluster = ClusterRegistry()
+        for name, engine in engines.items():
+            cluster.register(name, ROLE_BOTH, InProcTransport(engine))
+        router = FleetRouter(cluster)
+        for engine in engines.values():
+            await engine.start()
+        try:
+            baseline = {n: e._pool.free_pages for n, e in engines.items()}
+            outs, max_gaps_ms, total = [], [], 0
+            start = time.perf_counter()
+            for i, prompt in enumerate(prompts):
+                if chaos:
+                    # vary the crash depth so recovery is priced across
+                    # early/late kills, not one lucky token index
+                    faults.install(faults.FaultPlan(
+                        f"crash_mid_decode:@{3 + i % 5}", seed=i))
+                try:
+                    session = await router.generate_stream(
+                        prompt, max_new_tokens=budget)
+                    tokens, max_gap = [], 0.0
+                    last = time.perf_counter()
+                    async for token in session:
+                        now = time.perf_counter()
+                        max_gap = max(max_gap, now - last)
+                        last = now
+                        tokens.append(token)
+                finally:
+                    faults.reset()
+                outs.append(tokens)
+                max_gaps_ms.append(max_gap * 1000.0)
+                total += len(tokens)
+            elapsed = time.perf_counter() - start
+
+            deadline = time.perf_counter() + 10.0
+            while {n: e._pool.free_pages
+                   for n, e in engines.items()} != baseline:
+                if time.perf_counter() > deadline:
+                    break
+                await asyncio.sleep(0.05)
+            pages_restored = {n: e._pool.free_pages
+                              for n, e in engines.items()} == baseline
+            gaps = sorted(max_gaps_ms)
+            return {
+                "outs": outs,
+                "tok_s": round(total / elapsed, 1) if elapsed else None,
+                "max_gap_ms": round(gaps[len(gaps) // 2], 2),
+                "resumes": dict(router.fleet_stats()["resumes"]),
+                "pages_restored": pages_restored,
+            }
+        finally:
+            for engine in engines.values():
+                await engine.stop()
+
+    control = asyncio.run(arm(chaos=False))
+    chaos = asyncio.run(arm(chaos=True))
+
+    goodput = None
+    if control["tok_s"] and chaos["tok_s"]:
+        goodput = round(chaos["tok_s"] / control["tok_s"], 3)
+    exactly_once = all(
+        len(healed) == budget
+        and healed[:3 + i % 5 - 1] == ref[:3 + i % 5 - 1]
+        for i, (ref, healed) in enumerate(zip(control["outs"],
+                                              chaos["outs"])))
+    identical = sum(ref == healed for ref, healed
+                    in zip(control["outs"], chaos["outs"]))
+    return {
+        "preset": preset,
+        "requests": n_requests,
+        "budget": budget,
+        "decode_tok_s_control": control["tok_s"],
+        "decode_tok_s_chaos": chaos["tok_s"],
+        "goodput_ratio": goodput,
+        "resume_downtime_ms": chaos["max_gap_ms"],
+        "max_gap_ms_control": control["max_gap_ms"],
+        # acceptance: recovery must be invisible in CONTENT even while
+        # it costs time — full budget, exact pre-crash prefix, no leaks
+        "exactly_once": exactly_once,
+        "identical_streams": identical,
+        "resumes": chaos["resumes"],
+        "pages_restored": (control["pages_restored"]
+                           and chaos["pages_restored"]),
+        "note": ("in-proc fleet: downtime is re-admission + re-prefill "
+                 "of prompt+emitted on the resume target, no network or "
+                 "failure-detection latency priced — compare the chaos "
+                 "arm against control from the SAME run, not across "
+                 "rounds; identical_streams < requests without "
+                 "exactly_once=false means exact-logit-tie argmax "
+                 "flips at the re-prefill, not lost or duplicated "
+                 "tokens"),
     }
 
 

@@ -15,6 +15,7 @@ from gofr_tpu.analysis.rules.gt007_host_alloc import HostAllocRule
 from gofr_tpu.analysis.rules.gt008_label_cardinality import \
     LabelCardinalityRule
 from gofr_tpu.analysis.rules.gt009_cron import CronReentrancyRule
+from gofr_tpu.analysis.rules.gt010_retry import UnboundedRetryRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -26,6 +27,7 @@ ALL_RULES = (
     HostAllocRule,
     LabelCardinalityRule,
     CronReentrancyRule,
+    UnboundedRetryRule,
 )
 
 

@@ -1,0 +1,161 @@
+"""GT010 unbounded retry: broad except inside a forever loop, no escape.
+
+The chaos plane (ISSUE 14) makes retrying failures a first-class move —
+and the classic way that move goes wrong is the blind retry loop::
+
+    while True:
+        try:
+            await fetch()
+        except Exception:
+            continue          # spins hot forever against a dead peer
+
+A persistent failure (peer gone, auth revoked, payload poisoned) turns
+that loop into a busy-wait that hammers the dependency, pins a core,
+and hides the outage from every caller. The repo's sanctioned shape is
+``tpu/retry.py``'s :class:`RetryPolicy` — a bounded ``for`` over an
+attempt budget with jittered backoff — which this rule cannot flag by
+construction (no ``while True``).
+
+Detection — for each ``while`` loop whose test is constantly true
+(``while True:`` / ``while 1:``), every ``try`` in the loop's own body
+with a *broad* handler (bare ``except``, ``except Exception``, or
+``except BaseException``, alone or in a tuple) is a finding unless the
+handler's own body (nested defs excluded) contains at least one of:
+
+- an escape — ``raise``, ``return``, or ``break`` (the failure can
+  leave the loop), or
+- pacing — a ``*.sleep(...)`` / ``*.wait(...)`` call (the retry is
+  throttled, so a persistent failure degrades to a slow poll instead of
+  a hot spin). Pacing anywhere in the *loop's* own body clears the
+  whole loop: a poll loop that sleeps between iterations cannot spin
+  hot no matter which handler swallows (a ``continue`` can skip a
+  trailing sleep, but that shape is rare enough to accept).
+
+Loops whose test can go false (``while not self._draining``) terminate
+by state and are skipped, as are ``try`` statements *wrapping* the loop
+(a caught failure there exits the loop, it does not retry) and ``try``
+statements nested *inside* another handler (error-path cleanup — the
+swallow guards recovery code, not the retried operation). Narrow
+handlers (``except KVWireError``) are deliberate routing, not blind
+swallowing, and pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from gofr_tpu.analysis.engine import Finding, ModuleInfo, Rule
+
+_BROAD = {"Exception", "BaseException"}
+_PACED_CALLS = {"sleep", "wait"}
+
+
+def _constant_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _own_walk(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``node`` skipping nested function/lambda bodies — their
+    control flow belongs to the nested callable, not this loop."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _own_walk(child)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    for node in types:
+        name = node.id if isinstance(node, ast.Name) else (
+            node.attr if isinstance(node, ast.Attribute) else None)
+        if name in _BROAD:
+            return True
+    return False
+
+
+def _escapes(handler: ast.ExceptHandler) -> bool:
+    for node in _own_walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return True
+    return False
+
+
+def _paced(scope: ast.AST) -> bool:
+    """True when ``scope``'s own walk contains a sleep/wait call."""
+    for node in _own_walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name in _PACED_CALLS:
+            return True
+    return False
+
+
+def _in_handler(module: ModuleInfo, node: ast.AST,
+                loop: ast.While) -> bool:
+    """True when ``node`` sits inside an except handler between itself
+    and ``loop`` — error-path cleanup, not the retried operation."""
+    cursor = module.parents.get(node)
+    while cursor is not None and cursor is not loop:
+        if isinstance(cursor, ast.ExceptHandler):
+            return True
+        cursor = module.parents.get(cursor)
+    return False
+
+
+def _loop_owner(module: ModuleInfo, loop: ast.While) -> str:
+    node = loop
+    while node in module.parents:
+        node = module.parents[node]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node.name
+    return "<module>"
+
+
+class UnboundedRetryRule(Rule):
+    rule_id = "GT010"
+    title = "unbounded-retry"
+    severity = "error"
+
+    def check_module(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, ast.While) or \
+                    not _constant_true(loop.test):
+                continue
+            if _paced(loop):
+                continue
+            for node in _own_walk(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                if _in_handler(module, node, loop):
+                    continue
+                for handler in node.handlers:
+                    if not _is_broad(handler):
+                        continue
+                    if _escapes(handler):
+                        continue
+                    owner = _loop_owner(module, loop)
+                    findings.append(Finding(
+                        rule=self.rule_id,
+                        path=module.relpath,
+                        line=handler.lineno,
+                        message=(
+                            f"broad except inside '{owner}'s "
+                            f"while-True loop swallows every failure "
+                            f"and retries immediately — a persistent "
+                            f"failure spins hot forever; bound the "
+                            f"attempts (tpu/retry.py RetryPolicy), "
+                            f"back off before retrying, or re-raise"),
+                        severity=self.severity,
+                        key=f"unbounded retry in {owner}",
+                    ))
+        return findings

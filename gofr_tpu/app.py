@@ -412,15 +412,33 @@ class App:
                 logger=self.logger, tracer=self.container.tracer,
                 slo=self.container.slo, metrics=self.container.metrics)
 
+        # chaos plane (ISSUE 14): FAULT_PLAN installs a seeded
+        # fault-injection plan over the serving layers' named sites.
+        # Unset (the production default) leaves the no-op singleton — the
+        # injection sites cost one attribute load plus a dict miss.
+        from gofr_tpu.tpu import faults
+        plan = faults.plan_from_env(metrics=self.container.metrics)
+        if plan is not None:
+            faults.install(plan)
+            self.logger.warn("fault injection ACTIVE: FAULT_PLAN=%r "
+                             "(seed %d)", os.environ.get("FAULT_PLAN"),
+                             plan.seed)
+
         # degradation watchdog over the SLO rolling windows (slo.py);
         # SLO_WATCHDOG_ENABLED=false opts out entirely. The executor's
         # compile ledger (when present) feeds its recompile-storm signal.
-        from gofr_tpu.slo import new_watchdog
+        from gofr_tpu.slo import new_brownout, new_watchdog
         self.container.watchdog = new_watchdog(
             self.config, self.container.slo, metrics=self.container.metrics,
             logger=self.logger,
             ledger=getattr(self.container.tpu, "ledger", None))
         if self.container.watchdog is not None:
+            # brownout ladder (ISSUE 14): graduated shedding fed by the
+            # watchdog's evaluations, enforced by the engine — only wired
+            # when the serving engine can actually act on a level
+            self.container.watchdog.brownout = new_brownout(
+                self.config, self.container.tpu,
+                metrics=self.container.metrics, logger=self.logger)
             self.container.watchdog.start()
 
         # async inference lane (ISSUE 11): BATCH_LANE_TOPIC turns the

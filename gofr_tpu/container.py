@@ -366,6 +366,44 @@ class Container:
         metrics.new_gauge(
             "app_tpu_fleet_decode_replicas",
             "READY decode-serving replicas the autoscaler last observed")
+        # chaos plane catalog (ISSUE 14): seeded fault injection and the
+        # recovery machinery it exercises — retries, hedges, circuit
+        # trials, resumable decode, quarantine, and the brownout ladder
+        metrics.new_counter(
+            "app_tpu_fault_injected_total",
+            "seeded faults the FAULT_PLAN actually fired, by site — "
+            "zero outside chaos runs")
+        metrics.new_counter(
+            "app_tpu_disagg_retry_total",
+            "disaggregated-serving retries by leg (prefill|fetch) — "
+            "each one is a transient failure the budget absorbed")
+        metrics.new_counter(
+            "app_tpu_disagg_hedge_total",
+            "hedged backup dispatches by leg — the primary blew the "
+            "hedge deadline and an idempotent backup raced it")
+        metrics.new_counter(
+            "app_tpu_circuit_state_total",
+            "circuit-breaker transitions by state entered "
+            "(open|half_open|closed) — half_open admits one trial "
+            "in flight, its outcome closes or re-opens")
+        metrics.new_gauge(
+            "app_tpu_brownout_level",
+            "brownout ladder rung per role: 0 healthy, 1 shed batch, "
+            "2 cap speculation, 3 speculation off")
+        metrics.new_counter(
+            "app_tpu_slot_quarantine_total",
+            "poisoned slots excised mid-tick per (model, reason) — "
+            "reason is grammar (walker raised) or nan_logits "
+            "(out-of-vocab token ids); the rest of the batch proceeds")
+        metrics.new_counter(
+            "app_tpu_adopt_dedup_total",
+            "replayed KV adoptions answered from the dedupe ledger, "
+            "per model — a retry/hedge landed twice and was deduped")
+        metrics.new_counter(
+            "app_tpu_fleet_resume_total",
+            "mid-stream decode resumes by result (ok|no_ctx|budget|"
+            "exhausted|no_replica|error) — ok means the stream was "
+            "rebuilt from prompt + emitted tokens on a live replica")
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",

@@ -2,22 +2,33 @@
 
 Capability parity with ``pkg/gofr/service/circuit_breaker.go``
 (CircuitBreakerConfig{Threshold,Interval} 24-27; closed/open states 12-15;
-executeWithCircuitBreaker 59-90; background health ticker that closes the
-circuit when the health endpoint answers 101-120; wraps all verbs 216-271).
+executeWithCircuitBreaker 59-90; wraps all verbs 216-271), extended with a
+**half-open** state the Go port lacks: instead of a background health
+ticker silently reopening the circuit to full traffic, the first request
+after the cooldown ``interval`` becomes a *single-flight trial* — it alone
+reaches the peer while concurrent requests keep fast-failing. A
+successful trial closes the circuit; a failed one reopens it for another
+full cooldown. State transitions are counted in
+``app_tpu_circuit_state_total{state}`` so a flapping peer is visible as a
+transition rate, not just an open/closed gauge.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
 
 from gofr_tpu.service.client import HTTPService, ServiceError
 from gofr_tpu.service.options import Option
 
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+
 
 class CircuitOpenError(ServiceError):
-    """Fast-fail while the circuit is open."""
+    """Fast-fail while the circuit is open (or a half-open trial is
+    already in flight)."""
 
 
 class CircuitBreakerConfig(Option):
@@ -36,67 +47,101 @@ class _CircuitBreakerService(HTTPService):
         self._threshold = threshold
         self._interval = interval
         self._failures = 0
-        self._open = False
+        self._state = STATE_CLOSED
+        self._opened_at = 0.0
+        self._trial_inflight = False
         self._lock = threading.Lock()
-        self._probe: Optional[threading.Thread] = None
-        self._stop = threading.Event()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
 
     @property
     def is_open(self) -> bool:
-        return self._open
+        """True while requests would fast-fail *right now*: open and
+        still cooling down, or half-open with the trial in flight. An
+        open circuit past its cooldown reads as routable again — the
+        next request through is the trial."""
+        with self._lock:
+            if self._state == STATE_OPEN:
+                return time.monotonic() - self._opened_at < self._interval
+            if self._state == STATE_HALF_OPEN:
+                return self._trial_inflight
+            return False
 
     def request(self, method, path, params=None, body=None, headers=None):
+        trial = False
         with self._lock:
-            if self._open:
-                raise CircuitOpenError(
-                    f"circuit open for {self.service_name}")
+            if self._state == STATE_OPEN:
+                if time.monotonic() - self._opened_at < self._interval:
+                    raise CircuitOpenError(
+                        f"circuit open for {self.service_name}")
+                # cooldown over: this request is the single-flight trial
+                self._transition(STATE_HALF_OPEN)
+                self._trial_inflight = True
+                trial = True
+            elif self._state == STATE_HALF_OPEN:
+                if self._trial_inflight:
+                    raise CircuitOpenError(
+                        f"circuit half-open for {self.service_name}: "
+                        "trial request already in flight")
+                self._trial_inflight = True
+                trial = True
         try:
             response = self._inner.request(method, path, params=params,
                                            body=body, headers=headers)
         except ServiceError:
-            self._on_failure()
+            self._on_failure(trial)
             raise
         if response.status_code >= 500:
-            self._on_failure()
+            self._on_failure(trial)
         else:
-            with self._lock:
-                self._failures = 0
+            self._on_success(trial)
         return response
 
-    def _on_failure(self) -> None:
+    def _on_failure(self, trial: bool = False) -> None:
         with self._lock:
-            self._failures += 1
-            if self._failures >= self._threshold and not self._open:
-                self._open = True
-                if self.logger is not None:
-                    self.logger.warn("circuit OPEN for %s after %d failures",
-                                     self.service_name, self._failures)
-                self._start_probe()
-
-    # -- recovery probe (circuit_breaker.go:101-120) ------------------------
-    def _start_probe(self) -> None:
-        self._stop.clear()
-        self._probe = threading.Thread(target=self._probe_loop, daemon=True,
-                                       name=f"cb-probe-{self.service_name}")
-        self._probe.start()
-
-    def _probe_loop(self) -> None:
-        while not self._stop.wait(self._interval):
-            health = self._inner.health_check()
-            if health.get("status") == "UP":
-                with self._lock:
-                    self._open = False
-                    self._failures = 0
-                if self.logger is not None:
-                    self.logger.info("circuit CLOSED for %s (health probe ok)",
-                                     self.service_name)
+            if trial or self._state == STATE_HALF_OPEN:
+                # the trial failed — back to a full cooldown
+                self._trial_inflight = False
+                self._opened_at = time.monotonic()
+                self._failures = self._threshold
+                self._transition(STATE_OPEN)
                 return
+            self._failures += 1
+            if self._failures >= self._threshold \
+                    and self._state == STATE_CLOSED:
+                self._opened_at = time.monotonic()
+                self._transition(STATE_OPEN)
+
+    def _on_success(self, trial: bool) -> None:
+        with self._lock:
+            self._failures = 0
+            if trial or self._state != STATE_CLOSED:
+                self._trial_inflight = False
+                self._transition(STATE_CLOSED)
+
+    def _transition(self, to: str) -> None:
+        """State change under ``self._lock``; logs + transition counter."""
+        if to == self._state:
+            return
+        came_from = self._state
+        self._state = to
+        if self.logger is not None:
+            log = self.logger.warn if to == STATE_OPEN else self.logger.info
+            log("circuit %s for %s (was %s, %d failures)",
+                to.upper(), self.service_name, came_from, self._failures)
+        metrics = getattr(self, "metrics", None)
+        if metrics is not None:
+            metrics.increment_counter(
+                "app_tpu_circuit_state_total", state=to)
 
     def health_check(self):
         health = self._inner.health_check()
-        health.setdefault("details", {})["circuit"] = (
-            "open" if self._open else "closed")
+        health.setdefault("details", {})["circuit"] = self.state
         return health
 
     def close(self) -> None:
-        self._stop.set()
+        """Kept for API compatibility with the probe-thread breaker; the
+        half-open design has no background thread to stop."""

@@ -254,7 +254,8 @@ class Watchdog:
                  max_serving_compiles: Optional[int] = None,
                  role: str = "both",
                  hbm_fn: Any = None,
-                 max_hbm_occupancy: Optional[float] = None):
+                 max_hbm_occupancy: Optional[float] = None,
+                 brownout: Any = None):
         self.slo = slo
         self.metrics = metrics
         self.logger = logger
@@ -279,6 +280,11 @@ class Watchdog:
         # ``max_hbm_occupancy`` degrades before the allocator OOMs.
         self.hbm_fn = hbm_fn
         self.max_hbm_occupancy = max_hbm_occupancy
+        # brownout ladder (BrownoutLadder): graduated load-shedding fed
+        # by every evaluation, so the replica degrades in steps (shed
+        # batch → cap spec γ → spec off) BEFORE the hysteresis-gated
+        # DEGRADED flip pulls it from the load balancer entirely
+        self.brownout = brownout
         self.window_s = window_s
         self.interval_s = interval_s
         self.hysteresis = max(1, int(hysteresis))
@@ -326,6 +332,8 @@ class Watchdog:
                     f"hbm occupancy {occupancy:.3f} > "
                     f"{self.max_hbm_occupancy}")
         self._last_reasons = reasons
+        if self.brownout is not None:
+            self.brownout.observe(bool(reasons))
         if reasons:
             self._bad_streak += 1
             self._good_streak = 0
@@ -382,7 +390,7 @@ class Watchdog:
             self._task = None
 
     def statusz(self) -> Dict[str, Any]:
-        return {
+        out = {
             "state": self.state,
             "role": self.role,
             "transitions": self.transitions,
@@ -399,6 +407,109 @@ class Watchdog:
                 "min_requests": self.min_requests,
             },
         }
+        if self.brownout is not None:
+            out["brownout"] = self.brownout.statusz()
+        return out
+
+
+class BrownoutLadder:
+    """Graduated degradation between "healthy" and the watchdog's full
+    DEGRADED shed (ISSUE 14 brownout ladder).
+
+    The watchdog feeds every evaluation in (``observe(pressure)``).
+    Sustained pressure climbs one rung per ``escalate_after``
+    consecutive bad evaluations; sustained calm descends one rung per
+    ``recover_after`` consecutive good ones — recovery is deliberately
+    slower than escalation so a marginal replica does not oscillate.
+    Rungs (enforced by the engine via ``apply_fn`` = ``set_brownout``;
+    admission classes from :func:`gofr_tpu.tpu.sched.brownout_shed_classes`):
+
+    - level 1 — shed ``batch``-class admissions.
+    - level 2 — also cap speculative-decode γ at 1.
+    - level 3 — also disable speculative decode outright.
+
+    All of it happens while the watchdog is still READY — the ladder
+    exists so the replica gives up throughput before it gives up its
+    place in the load balancer."""
+
+    MAX_LEVEL = 3
+
+    def __init__(self, apply_fn: Any = None, metrics: Any = None,
+                 logger: Any = None, *, escalate_after: int = 2,
+                 recover_after: int = 4, role: str = "both"):
+        self.apply_fn = apply_fn
+        self.metrics = metrics
+        self.logger = logger
+        self.role = role
+        self.escalate_after = max(1, int(escalate_after))
+        self.recover_after = max(1, int(recover_after))
+        self.level = 0
+        self.transitions = 0
+        self._pressed = 0
+        self._calm = 0
+
+    def observe(self, pressure: bool) -> int:
+        """Feed one watchdog evaluation; returns the (possibly new)
+        brownout level."""
+        if pressure:
+            self._pressed += 1
+            self._calm = 0
+            if (self._pressed >= self.escalate_after
+                    and self.level < self.MAX_LEVEL):
+                self._pressed = 0
+                self._set(self.level + 1)
+        else:
+            self._calm += 1
+            self._pressed = 0
+            if self._calm >= self.recover_after and self.level > 0:
+                self._calm = 0
+                self._set(self.level - 1)
+        return self.level
+
+    def _set(self, level: int) -> None:
+        previous, self.level = self.level, level
+        self.transitions += 1
+        if self.apply_fn is not None:
+            try:
+                self.apply_fn(level)
+            except Exception as exc:
+                if self.logger is not None:
+                    self.logger.error(
+                        "brownout: apply_fn(%d) failed: %r", level, exc)
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_tpu_brownout_level", float(level),
+                                   role=self.role)
+        if self.logger is not None:
+            log = self.logger.warn if level > previous else self.logger.info
+            log("brownout: level %d -> %d", previous, level)
+
+    def statusz(self) -> Dict[str, Any]:
+        return {
+            "level": self.level,
+            "transitions": self.transitions,
+            "pressed": self._pressed,
+            "calm": self._calm,
+            "escalate_after": self.escalate_after,
+            "recover_after": self.recover_after,
+        }
+
+
+def new_brownout(config: Any, engine: Any, metrics: Any = None,
+                 logger: Any = None) -> Optional[BrownoutLadder]:
+    """Config-driven factory (``BROWNOUT_ENABLED``, default on when the
+    engine can enforce levels). Returns None when disabled or when
+    ``engine`` lacks ``set_brownout`` — a ladder nobody enforces is
+    noise."""
+    apply_fn = getattr(engine, "set_brownout", None)
+    if apply_fn is None:
+        return None
+    if not config.get_bool("BROWNOUT_ENABLED", True):
+        return None
+    return BrownoutLadder(
+        apply_fn, metrics=metrics, logger=logger,
+        role=config.get_or_default("CLUSTER_ROLE", "both"),
+        escalate_after=int(config.get_float("BROWNOUT_ESCALATE_AFTER", 2)),
+        recover_after=int(config.get_float("BROWNOUT_RECOVER_AFTER", 4)))
 
 
 def new_watchdog(config: Any, slo: SLOTracker, metrics: Any = None,

@@ -57,6 +57,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Set
 
 from gofr_tpu.slo import STATE_DEGRADED
+from gofr_tpu.tpu import faults
 
 # how much of a malformed payload rides along in the dead-letter
 # envelope — enough to debug, bounded so one 10MB blob can't amplify
@@ -350,6 +351,11 @@ class BatchLane:
         }
 
     async def _publish(self, topic: str, payload: Dict[str, Any]) -> None:
+        # chaos site (ISSUE 14): a dropped broker publish sends the
+        # result down the dead-letter path (and a dropped dead-letter
+        # publish is logged and swallowed) — the job commits either
+        # way, so one flaky broker can never wedge the partition
+        faults.active().raise_if("broker_drop")
         body = json.dumps(payload).encode("utf-8")
         result = self._broker.publish(topic, body)
         if asyncio.iscoroutine(result):

@@ -45,9 +45,10 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from gofr_tpu.tpu import kv_wire
+from gofr_tpu.tpu import faults, kv_wire
 from gofr_tpu.tpu.registry import (STATE_DRAINING, STATE_READY,
                                    _STATE_GAUGE)
+from gofr_tpu.tpu.retry import RetryBudgetExceeded, RetryPolicy
 from gofr_tpu.trace import current_span
 from gofr_tpu.trace.tracer import format_traceparent
 
@@ -211,6 +212,10 @@ class InProcTransport:
                       traceparent: Optional[str] = None) -> bytes:
         payload = await self.engine.prefill_export(
             prompt_ids, sampling=sampling, traceparent=traceparent)
+        # chaos site transport_prefill: the work succeeded but the reply
+        # is lost — the router's retry leg must treat prefill as
+        # idempotent and simply redo it on another (or the same) replica
+        faults.active().raise_if("transport_prefill")
         loop = asyncio.get_running_loop()
         blob = await loop.run_in_executor(None, kv_wire.pack, payload)
         return kv_wire.assemble(
@@ -220,7 +225,11 @@ class InProcTransport:
                     eos_id: Optional[int], sampling,
                     traceparent: Optional[str] = None,
                     submitted_at: Optional[float] = None,
-                    transfer_s: float = 0.0):
+                    transfer_s: float = 0.0,
+                    dedupe: Optional[str] = None):
+        # chaos site crash_mid_transfer: the replica dies while the blob
+        # is in flight — the adopt never lands, no slot is claimed
+        faults.active().raise_if("crash_mid_transfer")
         loop = asyncio.get_running_loop()
         # the unpack is the in-proc leg's share of the wire cost; fold it
         # into the transfer figure the decode record reports
@@ -230,11 +239,13 @@ class InProcTransport:
         return await self.engine.adopt_kv(
             payload, max_new_tokens, eos_id=eos_id, sampling=sampling,
             submitted_at=submitted_at, traceparent=traceparent,
-            transfer_s=transfer_s, transfer_bytes=len(blob))
+            transfer_s=transfer_s, transfer_bytes=len(blob),
+            dedupe=dedupe)
 
     async def adopt_session(self, blob: bytes, state: Dict[str, Any],
                             traceparent: Optional[str] = None,
-                            transfer_s: float = 0.0):
+                            transfer_s: float = 0.0,
+                            dedupe: Optional[str] = None):
         """Adopt a live decode session snapshot (ISSUE 12): same wire
         pipeline as ``adopt``, but the engine resumes decoding mid-stream
         — no first-token re-publish, remaining budget and sampling state
@@ -253,7 +264,7 @@ class InProcTransport:
             eos_id=state.get("eos_id"), sampling=sampling,
             submitted_at=state.get("submitted_at"),
             traceparent=traceparent, transfer_s=transfer_s,
-            transfer_bytes=len(blob))
+            transfer_bytes=len(blob), dedupe=dedupe)
 
     async def observe(self) -> Dict[str, Any]:
         """One clusterz probe: the replica's engine stats + SLO view.
@@ -306,7 +317,8 @@ class HTTPTransport:
     def __init__(self, base_url: str, grpc_target: Optional[str] = None,
                  service=None, breaker_threshold: int = 5,
                  breaker_interval: float = 10.0, timeout: float = 120.0,
-                 logger=None, metrics=None, tracer=None):
+                 logger=None, metrics=None, tracer=None,
+                 retry_policy: Optional[RetryPolicy] = None):
         from gofr_tpu.service.circuit_breaker import CircuitBreakerConfig
         from gofr_tpu.service.client import HTTPService
         if service is None:
@@ -317,6 +329,11 @@ class HTTPTransport:
             breaker_threshold, breaker_interval).add_option(service)
         self.grpc_target = grpc_target
         self.logger = logger
+        # the handoff fetch is idempotent (GET of an immutable blob), so
+        # it earns a small bounded retry; control-plane POSTs do not —
+        # the router owns those budgets
+        self.retry = retry_policy if retry_policy is not None \
+            else RetryPolicy(attempts=2, base_s=0.05)
 
     def available(self) -> bool:
         return not getattr(self.service, "is_open", False)
@@ -351,24 +368,36 @@ class HTTPTransport:
                     self.logger.warn(
                         "grpc KV fetch from %s failed (%r); falling back "
                         "to HTTP", self.grpc_target, exc)
-        response = await self.service.aget(
-            "/disagg/fetch", params={"handoff": handoff}, headers=headers)
-        if not response.ok:
-            raise RuntimeError(
-                f"handoff fetch answered {response.status_code}")
-        return response.body
+
+        async def attempt(n: int) -> bytes:
+            response = await self.service.aget(
+                "/disagg/fetch", params={"handoff": handoff},
+                headers=headers)
+            if not response.ok:
+                raise RuntimeError(
+                    f"handoff fetch answered {response.status_code}")
+            return response.body
+        try:
+            return await self.retry.run(attempt)
+        except RetryBudgetExceeded as exc:
+            raise (exc.__cause__ or exc) from None
 
     async def adopt(self, blob: bytes, max_new_tokens: int,
                     eos_id: Optional[int], sampling,
                     traceparent: Optional[str] = None,
                     submitted_at: Optional[float] = None,
-                    transfer_s: float = 0.0):
+                    transfer_s: float = 0.0,
+                    dedupe: Optional[str] = None):
         headers = {"Content-Type": "application/octet-stream"}
         if traceparent:
             headers["traceparent"] = traceparent
         params = {"max_new_tokens": int(max_new_tokens)}
         if eos_id is not None:
             params["eos_id"] = int(eos_id)
+        if dedupe:
+            # idempotency key: a replayed adopt for the same id returns
+            # the peer's prior stream instead of double-claiming pages
+            params["dedupe"] = dedupe
         params.update(_sampling_dict(sampling))
         response = await self.service.apost(
             "/disagg/adopt", params=params, body=bytes(blob),
@@ -381,7 +410,8 @@ class HTTPTransport:
 
     async def adopt_session(self, blob: bytes, state: Dict[str, Any],
                             traceparent: Optional[str] = None,
-                            transfer_s: float = 0.0):
+                            transfer_s: float = 0.0,
+                            dedupe: Optional[str] = None):
         """Ship a live session snapshot to a remote decode peer. Like
         ``adopt``, the response is the buffered remainder of the
         completion relayed token-wise; the peer resumes mid-stream with
@@ -397,6 +427,8 @@ class HTTPTransport:
         }
         if state.get("eos_id") is not None:
             params["eos_id"] = int(state["eos_id"])
+        if dedupe:
+            params["dedupe"] = dedupe
         response = await self.service.apost(
             "/disagg/adopt_session", params=params, body=bytes(blob),
             headers=headers)
@@ -720,12 +752,21 @@ class DisaggRouter:
     STITCH_CAPACITY = 256
 
     def __init__(self, registry: ClusterRegistry, logger=None,
-                 metrics=None, tracer=None):
+                 metrics=None, tracer=None,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.registry = registry
         self.logger = logger
         self.metrics = metrics
         self.tracer = tracer
+        # failure budget for the dispatch legs: prefill retries freely
+        # (idempotent — a fresh handoff per call), adopts retry only
+        # because every adopt carries a dedupe id the decode engine
+        # honors; hedging stays off unless the policy arms it
+        self.retry = retry_policy if retry_policy is not None \
+            else RetryPolicy()
         self._requests = 0
+        self._retries = 0
+        self._hedges = 0
         self._bytes_shipped = 0
         # recent transfer-leg wall times, for the clusterz quantile rollup
         self._transfer_window: "deque[float]" = deque(maxlen=512)
@@ -757,29 +798,25 @@ class DisaggRouter:
             trace_id = os.urandom(16).hex()
             traceparent = f"00-{trace_id}-{os.urandom(8).hex()}-01"
         t0 = time.perf_counter()
-        self.registry.note_start(prefiller)
         try:
-            blob = await prefiller.transport.prefill(
-                prompt_ids, sampling, traceparent=traceparent)
+            # each leg retries under the policy's budget; a wire-damaged
+            # blob (KVWireError at adopt) earns exactly ONE fresh prefill
+            # round — the blob itself is bad, so replaying the adopt
+            # alone can never recover
+            for wire_round in range(2):
+                prefiller, blob = await self._dispatch_prefill(
+                    prefiller, prompt_ids, sampling, traceparent)
+                t1 = time.perf_counter()
+                try:
+                    decoder, stream = await self._dispatch_adopt(
+                        decoder, blob, max_new_tokens, eos_id, sampling,
+                        traceparent, submitted_at, t1, dedupe=trace_id)
+                    break
+                except kv_wire.KVWireError:
+                    if wire_round:
+                        raise
+                    self._note_retry("wire")(1, None)
         except BaseException:
-            if span is not None:
-                span.set_status("ERROR")
-                span.finish()
-            raise
-        finally:
-            self.registry.note_end(prefiller)
-        t1 = time.perf_counter()
-        self.registry.note_start(decoder)
-        try:
-            # transfer_s seeds the decode record's wire figure with the
-            # post-prefill leg only; the transport adds its own unpack
-            # share — the prefill RPC wall must NOT be folded in here
-            stream = await decoder.transport.adopt(
-                blob, max_new_tokens, eos_id, sampling,
-                traceparent=traceparent, submitted_at=submitted_at,
-                transfer_s=time.perf_counter() - t1)
-        except BaseException:
-            self.registry.note_end(decoder)
             if span is not None:
                 span.set_status("ERROR")
                 span.finish()
@@ -816,7 +853,131 @@ class DisaggRouter:
             on_finish=lambda: entry.__setitem__(
                 "finished_at", time.monotonic()),
             trace_id=entry["trace_id"])
-        return self._wrap_stream(relay, decoder, stream)
+        # everything a recovery layer needs to rebuild this request from
+        # scratch on another replica (tpu/fleet.py resumable decode)
+        request = {
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "max_new_tokens": int(max_new_tokens),
+            "eos_id": eos_id,
+            "sampling": sampling,
+            "submitted_at": submitted_at,
+            "trace_id": trace_id,
+        }
+        return self._wrap_stream(relay, decoder, stream, request)
+
+    async def _dispatch_prefill(self, prefiller: Replica, prompt_ids,
+                                sampling, traceparent: Optional[str]
+                                ) -> Tuple[Replica, bytes]:
+        """The prefill leg under the retry budget. Prefill is idempotent
+        (every call mints a fresh handoff), so retries re-pick a replica
+        freely and, when the policy arms ``hedge_after_s``, a slow
+        primary is raced against a second replica — first blob wins."""
+        async def leg(replica: Replica) -> Tuple[Replica, bytes]:
+            self.registry.note_start(replica)
+            try:
+                return replica, await replica.transport.prefill(
+                    prompt_ids, sampling, traceparent=traceparent)
+            finally:
+                self.registry.note_end(replica)
+
+        async def attempt(n: int) -> Tuple[Replica, bytes]:
+            replica = prefiller if n == 1 \
+                else self._repick(ROLE_PREFILL, prefiller)
+            backup = None
+            if self.retry.hedge_after_s is not None:
+                alt = self._pick_alternate(ROLE_PREFILL, replica)
+                if alt is not None:
+                    def backup(alt: Replica = alt):
+                        return leg(alt)
+            result, hedged = await self.retry.hedged(
+                lambda: leg(replica), backup)
+            if hedged:
+                self._hedges += 1
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_tpu_disagg_hedge_total", leg="prefill")
+            return result
+        try:
+            return await self.retry.run(
+                attempt, on_retry=self._note_retry("prefill"))
+        except RetryBudgetExceeded as exc:
+            raise (exc.__cause__ or exc) from None
+
+    async def _dispatch_adopt(self, decoder: Replica, blob: bytes,
+                              max_new_tokens: int, eos_id: Optional[int],
+                              sampling, traceparent: Optional[str],
+                              submitted_at: float, t1: float, *,
+                              dedupe: str):
+        """The adopt leg under the retry budget. An adopt is NOT blindly
+        idempotent — a replayed adopt could double-claim pages — so every
+        attempt carries the request's ``dedupe`` id and the decode engine
+        answers a replay with the prior stream. Deterministic payload
+        rejections (:class:`KVWireError` and other ValueErrors) are not
+        retried here; the caller decides whether a fresh prefill is
+        worth one more round."""
+        async def attempt(n: int):
+            replica = decoder if n == 1 \
+                else self._repick(ROLE_DECODE, decoder)
+            self.registry.note_start(replica)
+            try:
+                # transfer_s seeds the decode record's wire figure with
+                # the post-prefill leg only; the transport adds its own
+                # unpack share — the prefill RPC wall must NOT be folded
+                # in here
+                stream = await replica.transport.adopt(
+                    blob, max_new_tokens, eos_id, sampling,
+                    traceparent=traceparent, submitted_at=submitted_at,
+                    transfer_s=time.perf_counter() - t1, dedupe=dedupe)
+            except BaseException:
+                self.registry.note_end(replica)
+                raise
+            return replica, stream
+        try:
+            return await self.retry.run(
+                attempt, retryable=lambda exc: not isinstance(
+                    exc, ValueError),
+                on_retry=self._note_retry("adopt"))
+        except RetryBudgetExceeded as exc:
+            raise (exc.__cause__ or exc) from None
+
+    def _repick(self, role: str, previous: Replica) -> Replica:
+        """Target for a retry attempt — prefer a different replica than
+        the one that just failed, fall back to it when it is the only
+        routable choice."""
+        try:
+            candidate = self.registry.pick(role)
+        except NoReplicaAvailable:
+            return previous
+        if candidate is not previous:
+            return candidate
+        try:
+            again = self.registry.pick(role)
+        except NoReplicaAvailable:
+            return candidate
+        return again if again is not previous else candidate
+
+    def _pick_alternate(self, role: str,
+                        exclude: Replica) -> Optional[Replica]:
+        """Least-loaded routable replica other than ``exclude`` — the
+        hedge target. None when the fleet has no second choice."""
+        candidates = [r for r in self.registry._replicas.values()
+                      if r.state == STATE_READY and r.serves(role)
+                      and r.transport.available() and r is not exclude]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: r.inflight)
+
+    def _note_retry(self, leg: str):
+        def note(attempt: int, exc: Optional[BaseException]) -> None:
+            self._retries += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_tpu_disagg_retry_total", leg=leg)
+            if self.logger is not None:
+                self.logger.warn(
+                    "disagg: %s attempt %d failed (%r); retrying",
+                    leg, attempt, exc)
+        return note
 
     def _pick_decode(self, prompt_ids) -> Replica:
         """Decode-target selection hook — the fleet router overrides this
@@ -825,10 +986,13 @@ class DisaggRouter:
         return self.registry.pick(ROLE_DECODE)
 
     def _wrap_stream(self, relay: "_RelayStream", decoder: Replica,
-                     stream) -> Any:
+                     stream, request: Optional[Dict[str, Any]] = None
+                     ) -> Any:
         """Relay post-processing hook — the fleet router wraps the relay
-        in a migratable session so live decode→decode migration can
-        splice a new replica's stream in mid-flight."""
+        in a migratable, *resumable* session: live decode→decode
+        migration can splice a new replica's stream in mid-flight, and a
+        replica crash mid-stream rebuilds the request (``request`` ctx)
+        on a surviving replica."""
         return relay
 
     def _remember(self, entry: Dict[str, Any]) -> None:
@@ -954,6 +1118,8 @@ class DisaggRouter:
     def stats(self) -> Dict[str, Any]:
         return {
             "requests": self._requests,
+            "retries": self._retries,
+            "hedges": self._hedges,
             "bytes_shipped": self._bytes_shipped,
             "kv_transfer_quantiles": self.transfer_quantiles(),
             "stitched_traces": len(self._stitches),

@@ -35,6 +35,7 @@ import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from gofr_tpu.tpu import faults
 from gofr_tpu.tpu.cluster import (DisaggRouter, NoReplicaAvailable,
                                   Replica, ROLE_DECODE, STATE_DRAINING,
                                   STATE_READY, _RelayStream)
@@ -121,7 +122,8 @@ class FleetPrefixIndex:
 
 
 class FleetSession:
-    """Client-facing token iterator that survives migration.
+    """Client-facing token iterator that survives migration AND replica
+    death (ISSUE 14 resumable decode).
 
     Wraps the router's :class:`_RelayStream`; when the fleet migrates
     the session, the source stream ends (the exporting engine closes its
@@ -129,16 +131,29 @@ class FleetSession:
     target replica's relay instead of surfacing the end — the client
     sees one uninterrupted stream. The future is armed *before* the
     export starts, so a consumer racing the migration can never fall
-    through the gap."""
+    through the gap.
+
+    When the relay *dies* instead (replica crash mid-decode, a migration
+    leg failing after the source slot was retired), the session hands
+    the failure to :meth:`FleetRouter._resume_session`, which rebuilds
+    the request on a surviving replica from the original prompt plus
+    every token already delivered — the continuation starts at exactly
+    token index ``len(_emitted)``, so the client stream carries no
+    duplicate and no missing token (and, under greedy sampling, is
+    token-identical to an uninterrupted run)."""
 
     def __init__(self, router: "FleetRouter", relay: _RelayStream,
-                 replica: Replica, stream) -> None:
+                 replica: Replica, stream,
+                 request: Optional[Dict[str, Any]] = None) -> None:
         self._router = router
         self._relay = relay
         self._replica = replica
         self._stream = stream          # inner engine TokenStream
         self._next: Optional[asyncio.Future] = None
+        self._request = request        # rebuild ctx for resume
+        self._emitted: List[int] = []  # tokens the client has seen
         self.migrations = 0
+        self.resumes = 0
 
     @property
     def replica_name(self) -> str:
@@ -154,7 +169,13 @@ class FleetSession:
     async def __anext__(self) -> int:
         while True:
             try:
-                return await self._relay.__anext__()
+                token = await self._relay.__anext__()
+                # chaos site (ISSUE 14): a decode replica dying
+                # mid-stream surfaces to the router as a relay failure
+                # AFTER some tokens were delivered — the token fetched
+                # above is lost with the replica, exactly like a real
+                # crash between produce and deliver
+                faults.active().raise_if("crash_mid_decode")
             except StopAsyncIteration:
                 fut = self._next
                 if fut is None:
@@ -162,15 +183,34 @@ class FleetSession:
                     raise
                 # migration in flight: the source stream just ended at
                 # the export point — wait for the spliced continuation
-                relay = await fut
                 self._next = None
+                try:
+                    relay = await fut
+                except BaseException as exc:
+                    # migration failed after the source slot was retired
+                    # (mid-migration crash): the session is still
+                    # rebuildable from prompt + emitted tokens
+                    relay = await self._router._resume_session(self, exc)
+                    if relay is None:
+                        self._router._unregister(self)
+                        raise
                 if relay is None:       # migration aborted; normal end
                     self._router._unregister(self)
                     raise
                 self._relay = relay
-            except BaseException:
+                continue
+            except asyncio.CancelledError:
                 self._router._unregister(self)
                 raise
+            except BaseException as exc:
+                relay = await self._router._resume_session(self, exc)
+                if relay is None:
+                    self._router._unregister(self)
+                    raise
+                self._relay = relay
+                continue
+            self._emitted.append(int(token))
+            return int(token)
 
     def cancel(self) -> None:
         self._relay.cancel()
@@ -204,6 +244,12 @@ class FleetRouter(DisaggRouter):
         self._route_fallback = 0
         self._migrations_ok = 0
         self._migrations_failed = 0
+        # resumable decode (ISSUE 14): how many mid-stream failures were
+        # healed by rebuilding the request on a surviving replica, and a
+        # per-session cap so a poisoned request cannot hop forever
+        self._resumes_ok = 0
+        self._resumes_failed = 0
+        self.resume_budget = 3
 
     # -- prefix index -------------------------------------------------------
     async def refresh(self) -> Dict[str, Any]:
@@ -260,7 +306,15 @@ class FleetRouter(DisaggRouter):
             raise
         self._requests += 1
         relay = _RelayStream(stream, self.registry, replica)
-        return self._wrap_stream(relay, replica, stream)
+        request = {
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "max_new_tokens": int(max_new_tokens),
+            "eos_id": eos_id,
+            "sampling": sampling,
+            "submitted_at": time.monotonic(),
+            "trace_id": None,
+        }
+        return self._wrap_stream(relay, replica, stream, request)
 
     def _route(self, prompt_ids) -> Tuple[Optional[Replica], int]:
         """``(replica, matched_pages)`` for local serving, or
@@ -305,8 +359,10 @@ class FleetRouter(DisaggRouter):
 
     # -- session registry ---------------------------------------------------
     def _wrap_stream(self, relay: _RelayStream, decoder: Replica,
-                     stream) -> FleetSession:
-        session = FleetSession(self, relay, decoder, stream)
+                     stream, request: Optional[Dict[str, Any]] = None
+                     ) -> FleetSession:
+        session = FleetSession(self, relay, decoder, stream,
+                               request=request)
         self._sessions.setdefault(decoder.name, set()).add(session)
         return session
 
@@ -317,6 +373,93 @@ class FleetRouter(DisaggRouter):
 
     def sessions(self, name: str) -> List[FleetSession]:
         return list(self._sessions.get(name, ()))
+
+    # -- resumable decode (ISSUE 14) ----------------------------------------
+    async def _resume_session(self, session: FleetSession,
+                              exc: BaseException
+                              ) -> Optional[_RelayStream]:
+        """Heal a mid-stream replica failure: rebuild the request on a
+        surviving in-proc decode replica from the original prompt plus
+        every token the client already received, with the budget shrunk
+        by the same count. The continuation starts at exactly the next
+        token index — exactly-once delivery without any wire-level
+        dedupe — and, under greedy sampling, is token-identical to an
+        uninterrupted run (the new replica's prefill of prompt+emitted
+        conditions it on the same committed sequence).
+
+        Returns the spliced relay, or None when the failure must
+        surface: no rebuild ctx, the per-session resume budget is spent,
+        a migration owns the session's transition, or no healthy peer
+        exists. Never called for client cancellation."""
+        if isinstance(exc, (asyncio.CancelledError, StopAsyncIteration)):
+            return None
+        request = session._request
+        if request is None or session._next is not None:
+            self._note_resume("no_ctx")
+            return None
+        if session.resumes >= self.resume_budget:
+            self._note_resume("budget")
+            return None
+        remaining = request["max_new_tokens"] - len(session._emitted)
+        if remaining <= 0:
+            self._note_resume("exhausted")
+            return None
+        dead = session._replica
+        candidates = [
+            r for r in self.registry._replicas.values()
+            if r.name != dead.name and r.state == STATE_READY
+            and r.serves(ROLE_DECODE) and r.transport.available()
+            and getattr(r.transport, "engine", None) is not None]
+        if not candidates:
+            self._note_resume("no_replica")
+            return None
+        target = min(candidates, key=lambda r: r.inflight)
+        # reclaim whatever the dead stream still holds (in-proc the
+        # "crash" may leave the engine decoding into an abandoned
+        # queue — cancel frees its slot and pages; a truly dead replica
+        # ignores this)
+        try:
+            cancel = getattr(session._stream, "cancel", None)
+            if cancel is not None:
+                cancel()
+        except Exception:   # noqa: BLE001 — the replica is already gone
+            pass
+        prompt = list(request["prompt_ids"]) + \
+            [int(t) for t in session._emitted]
+        engine = target.transport.engine
+        self.registry.note_start(target)
+        try:
+            stream = await engine.generate_stream(
+                prompt, remaining, eos_id=request.get("eos_id"),
+                sampling=request.get("sampling"))
+        except BaseException:
+            self.registry.note_end(target)
+            self._note_resume("error")
+            return None
+        session.resumes += 1
+        relay = _RelayStream(stream, self.registry, target,
+                             trace_id=session.trace_id)
+        self._sessions.get(dead.name, set()).discard(session)
+        session._replica = target
+        session._stream = stream
+        self._sessions.setdefault(target.name, set()).add(session)
+        self._note_resume("ok")
+        if self.logger is not None:
+            self.logger.warn(
+                "fleet: resumed session on %s after %r on %s "
+                "(%d tokens already delivered, %d remaining)",
+                target.name, exc, dead.name, len(session._emitted),
+                remaining)
+        return relay
+
+    def _note_resume(self, result: str) -> None:
+        if result == "ok":
+            self._resumes_ok += 1
+        else:
+            self._resumes_failed += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_fleet_resume_total", result=result)
 
     # -- live migration -----------------------------------------------------
     async def migrate_session(self, session: FleetSession,
@@ -364,14 +507,24 @@ class FleetRouter(DisaggRouter):
                 return kv_wire.assemble(kv_wire.iter_chunks(blob))
 
             blob = await loop.run_in_executor(None, ship)
+            # chaos site (ISSUE 14): the source slot is already retired,
+            # the payload never reaches the target — the worst moment a
+            # migration can die. Recovery is the session's resume path.
+            faults.active().raise_if("crash_mid_migration")
             trace_id = session.trace_id
             traceparent = (f"00-{trace_id}-{os.urandom(8).hex()}-01"
                            if trace_id else None)
+            # idempotent adopt (ISSUE 14): stable id per logical
+            # transfer, so a transport retry after a lost response
+            # cannot double-claim pages on the target
+            dedupe = (f"{trace_id or os.urandom(8).hex()}"
+                      f"-mig{session.migrations}")
             self.registry.note_start(target)
             try:
                 stream = await target.transport.adopt_session(
                     blob, state, traceparent=traceparent,
-                    transfer_s=time.perf_counter() - t0)
+                    transfer_s=time.perf_counter() - t0,
+                    dedupe=dedupe)
             except BaseException:
                 self.registry.note_end(target)
                 raise
@@ -464,6 +617,8 @@ class FleetRouter(DisaggRouter):
                         "fallback": self._route_fallback},
             "migrations": {"ok": self._migrations_ok,
                            "failed": self._migrations_failed},
+            "resumes": {"ok": self._resumes_ok,
+                        "failed": self._resumes_failed},
             "index": self.index.stats(),
             "sessions": {name: len(held)
                          for name, held in self._sessions.items()

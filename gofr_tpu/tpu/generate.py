@@ -58,11 +58,12 @@ import numpy as np
 
 from gofr_tpu.aio import spawn_logged
 from gofr_tpu.slo import DeadlineExceeded, current_deadline
+from gofr_tpu.tpu import faults
 from gofr_tpu.tpu.compile_ledger import ShapeStats, suggest_ladder
 from gofr_tpu.tpu.constrain import GrammarWalker
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.tpu.sched import (ClassQueues, DEFAULT_CLASS_WEIGHTS,
-                                deadline_class)
+                                brownout_shed_classes, deadline_class)
 from gofr_tpu.trace import Span, current_span, extract_traceparent
 
 DEFAULT_PROMPT_BUCKETS = (32, 128, 512)
@@ -77,6 +78,20 @@ _SPEC_GROW_ABOVE = 0.8
 
 # sentinel pushed onto a streaming queue when the request completes
 _DONE = object()
+
+# adopt-dedupe ledger (ISSUE 14): replayed adoptions within this window
+# return the original stream instead of claiming pages twice. Matches the
+# exporter-side HandoffTable default TTL so both halves of a handoff
+# forget a transfer id at the same time.
+_ADOPT_LEDGER_TTL_S = 120.0
+_ADOPT_LEDGER_CAP = 256
+
+
+class BrownoutShed(RuntimeError):
+    """Admission refused by the brownout ladder (slo.BrownoutLadder):
+    the replica is shedding this SLO class to protect interactive
+    traffic. Retryable elsewhere — handlers map it to 503."""
+    status_code = 503
 
 
 class Sampling:
@@ -624,6 +639,13 @@ class GenerationEngine:
         self._shed_by_class: Dict[str, int] = {}
         self._ticks_inflight = 0
         self._cancelled_queues: set = set()  # ids of abandoned stream queues
+        # chaos plane (ISSUE 14): idempotent-adopt ledger (dedupe id →
+        # (stored_at, stream)), brownout rung applied by slo.BrownoutLadder
+        # via set_brownout, and poison-slot quarantine accounting
+        self._adopt_ledger: Dict[str, Tuple[float, "TokenStream"]] = {}
+        self._adopt_dedup_hits = 0
+        self._brownout = 0
+        self._quarantined: Dict[str, int] = {}
 
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._insert_fns: Dict[Tuple[int, int], Any] = {}
@@ -1691,6 +1713,64 @@ class GenerationEngine:
         # with the flight — checked again at admission time
         return _Flight(link_span, qspan, record, deadline=current_deadline())
 
+    def set_brownout(self, level: int) -> None:
+        """Apply a brownout rung (``slo.BrownoutLadder`` apply_fn): 0
+        healthy, 1 shed batch-class admissions, 2 also cap speculative
+        γ at 1, 3 also disable speculative dispatch. Enforcement lives
+        engine-side so the ladder works for any caller (watchdog, tests,
+        an operator endpoint)."""
+        level = max(0, min(int(level), 3))
+        if level == self._brownout:
+            return
+        previous, self._brownout = self._brownout, level
+        if self.logger is not None:
+            log = self.logger.warn if level > previous else self.logger.info
+            log("engine %s: brownout level %d -> %d", self.model_name,
+                previous, level)
+
+    def _brownout_gate(self, cls: str, flight: _Flight) -> None:
+        """Brownout admission shed (ISSUE 14): refuse classes the current
+        rung sheds BEFORE queueing — a 503 the client can retry on another
+        replica beats queue time on one that will shed the request
+        anyway. Shares the shed accounting with the overflow breaker."""
+        if not self._brownout or cls not in brownout_shed_classes(
+                self._brownout):
+            return
+        if flight.qspan is not None:
+            flight.qspan.set_status("ERROR")
+            flight.qspan.finish()
+        self.recorder.finish(flight.record, "expired")
+        self._shed_by_class[cls] = self._shed_by_class.get(cls, 0) + 1
+        if self.slo is not None:
+            self.slo.record_outcome("expired", cls=cls)
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_sched_shed_total", model=self.model_name, cls=cls)
+        raise BrownoutShed(
+            f"brownout level {self._brownout}: shedding {cls!r} admissions")
+
+    def _adopt_ledger_get(self, dedupe: str) -> Optional["TokenStream"]:
+        """Idempotent-adopt lookup: a replayed transfer id inside the TTL
+        returns the stream the first adoption produced instead of
+        claiming a second slot and page set for the same KV."""
+        now = time.monotonic()
+        if len(self._adopt_ledger) > _ADOPT_LEDGER_CAP:
+            self._adopt_ledger = {
+                key: entry for key, entry in self._adopt_ledger.items()
+                if now - entry[0] < _ADOPT_LEDGER_TTL_S}
+        hit = self._adopt_ledger.get(dedupe)
+        if hit is None or now - hit[0] >= _ADOPT_LEDGER_TTL_S:
+            return None
+        self._adopt_dedup_hits += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_adopt_dedup_total", model=self.model_name)
+        if self.logger is not None:
+            self.logger.warn(
+                "engine %s: replayed adoption %s served from the dedupe "
+                "ledger", self.model_name, dedupe)
+        return hit[1]
+
     def _compile_grammar(self, response_format, eos_id):
         """Resolve a request's ``response_format`` through the per-engine
         grammar cache (raises :class:`~gofr_tpu.tpu.constrain.
@@ -1718,6 +1798,7 @@ class GenerationEngine:
         future = asyncio.get_running_loop().create_future()
         flight = self._new_flight(prompt, max_new_tokens)
         cls = deadline_class(flight.deadline)
+        self._brownout_gate(cls, flight)
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, None,
                                  time.monotonic(), flight, cls, grammar),
@@ -1748,6 +1829,7 @@ class GenerationEngine:
         future = asyncio.get_running_loop().create_future()
         flight = self._new_flight(prompt, max_new_tokens)
         cls = deadline_class(flight.deadline)
+        self._brownout_gate(cls, flight)
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, queue,
                                  time.monotonic(), flight, cls, grammar),
@@ -1869,7 +1951,8 @@ class GenerationEngine:
                        traceparent: Optional[str] = None,
                        transfer_s: float = 0.0,
                        transfer_bytes: int = 0,
-                       resume: bool = False) -> TokenStream:
+                       resume: bool = False,
+                       dedupe: Optional[str] = None) -> TokenStream:
         """Decode-replica half of the handoff: admit an exported
         :class:`~gofr_tpu.tpu.kv_wire.KVPayload` straight into the page
         pool as page-table entries and start decoding from its first
@@ -1884,9 +1967,19 @@ class GenerationEngine:
         the wire cost on this request's flight record and the
         ``app_tpu_kv_transfer_*`` series. Raises :class:`KVWireError`
         on geometry/codec mismatch and ``RuntimeError`` when no slot or
-        pages are free (router backpressure, not a request error)."""
+        pages are free (router backpressure, not a request error).
+
+        ``dedupe`` makes the adoption idempotent (ISSUE 14): a transport
+        that times out AFTER the engine admitted the pages may retry with
+        the same id and gets the original stream back instead of a
+        double-claim — exactly-once admission under at-least-once
+        delivery."""
         from gofr_tpu.tpu import kv_wire
         from gofr_tpu.tpu.sched import CLASS_MIGRATED
+        if dedupe is not None:
+            prior = self._adopt_ledger_get(dedupe)
+            if prior is not None:
+                return prior
         if not self.paged:
             raise ValueError("adopt_kv needs paged_kv=True (migrated KV "
                              "is admitted as page-table entries)")
@@ -2068,7 +2161,10 @@ class GenerationEngine:
             self._push_tokens(slot_idx, gen, [payload.first_token])
         if span is not None:
             span.finish()
-        return TokenStream(self, queue, future)
+        stream = TokenStream(self, queue, future)
+        if dedupe is not None:
+            self._adopt_ledger[dedupe] = (time.monotonic(), stream)
+        return stream
 
     async def adopt_session(self, payload, remaining: int,
                             eos_id: Optional[int] = None,
@@ -2076,7 +2172,8 @@ class GenerationEngine:
                             submitted_at: Optional[float] = None,
                             traceparent: Optional[str] = None,
                             transfer_s: float = 0.0,
-                            transfer_bytes: int = 0) -> TokenStream:
+                            transfer_bytes: int = 0,
+                            dedupe: Optional[str] = None) -> TokenStream:
         """Resume a live decode session exported by a peer's
         :meth:`export_session` (ISSUE 12). The payload's pages carry the
         session's whole committed KV (prompt + every token decoded so
@@ -2090,7 +2187,7 @@ class GenerationEngine:
             payload, remaining, eos_id=eos_id, sampling=sampling,
             submitted_at=submitted_at, traceparent=traceparent,
             transfer_s=transfer_s, transfer_bytes=transfer_bytes,
-            resume=True)
+            resume=True, dedupe=dedupe)
 
     async def export_session(self, stream,
                              timeout_s: float = 5.0):
@@ -2342,6 +2439,16 @@ class GenerationEngine:
                 "requests": self._constrained_requests,
                 "ticks": self._constrained_ticks,
                 "grammar_cache": self.grammar_cache.stats(),
+            }
+        if (self._brownout or self._quarantined or self._adopt_dedup_hits
+                or self._adopt_ledger):
+            # chaos-plane resilience accounting (ISSUE 14); sparse so a
+            # healthy replica's stats payload is unchanged
+            out["resilience"] = {
+                "brownout_level": self._brownout,
+                "quarantined": dict(self._quarantined),
+                "adopt_dedup_hits": self._adopt_dedup_hits,
+                "adopt_ledger_entries": len(self._adopt_ledger),
             }
         return out
 
@@ -2815,6 +2922,17 @@ class GenerationEngine:
             self._note_spec(proposed, accepted)
         else:
             self._ticks_inflight -= 1
+            plan = faults.active()
+            if plan.enabled and entry.payload \
+                    and plan.should("nan_logits"):
+                # chaos site (ISSUE 14): NaN/inf logits argmax to garbage
+                # token ids on device; model it host-side by poisoning
+                # one slot's fetched tokens out of vocab range so the
+                # _push_tokens breaker quarantines exactly that slot
+                # (host is already an ndarray — the fetch ran np.asarray
+                # on a worker thread — so this copy is host-side)
+                host = host.copy()
+                host[:, entry.payload[0][0]] = -1
             for slot_idx, gen in entry.payload:
                 self._push_tokens(slot_idx, gen,
                                   [int(t) for t in host[:, slot_idx]])
@@ -3419,6 +3537,11 @@ class GenerationEngine:
         only when a pending request could actually be admitted next
         iteration (pending non-empty AND a free slot exists) — under
         saturation there is nothing to admit, so fused-K ticks continue."""
+        # chaos site (ISSUE 14): a tick_exception fault surfaces exactly
+        # where a poisoned executable would — inside the loop body, where
+        # _loop's catch-all fails outstanding work and rebuilds device
+        # state
+        faults.active().raise_if("tick_exception")
         jnp = self._jnp
         # constrained slots only join a tick when no token of theirs is in
         # flight: their grammar mask is valid for exactly the next
@@ -3441,13 +3564,16 @@ class GenerationEngine:
             for rung in self._k_ladder:
                 if rung <= min_wanted:
                     k = rung
-            if self.spec and min_wanted >= 2:
+            if self.spec and min_wanted >= 2 and self._brownout < 3:
                 # speculative rung g commits UP TO g+1 tokens per slot, so
                 # it needs g+1 ≤ min_wanted — the same never-overshoot
-                # invariant as fused-K (device advance is accepts+1 ≤ g+1)
+                # invariant as fused-K (device advance is accepts+1 ≤ g+1).
+                # Brownout (ISSUE 14): level 2 pins γ to the cheapest
+                # rung, level 3 (checked above) drops speculation outright
                 g = 0
+                cap = 1 if self._brownout >= 2 else self._gamma_cap
                 for rung in self._g_ladder:
-                    if rung + 1 <= min_wanted and rung <= self._gamma_cap:
+                    if rung + 1 <= min_wanted and rung <= cap:
                         g = rung
                 if g > 0:
                     return await self._dispatch_spec(loop, eligible, g)
@@ -3875,6 +4001,18 @@ class GenerationEngine:
         # identical sequence either way.
         chunk: Optional[List[int]] = [] if self.coalesce_stream else None
         for token in tokens:
+            if token < 0 or token >= self.cfg.vocab_size:
+                # NaN/inf logits argmax to implementation-defined ids; an
+                # out-of-range token is the host-visible symptom. Fail
+                # THIS request, not the tick (ISSUE 14 quarantine).
+                if chunk and slot.queue is not None:
+                    slot.queue.put_nowait(chunk)
+                self._quarantine_slot(
+                    slot_idx, slot, "nan_logits", RuntimeError(
+                        f"slot {slot_idx} produced out-of-range token "
+                        f"{token} (vocab {self.cfg.vocab_size}); "
+                        "NaN/inf logits upstream — request quarantined"))
+                return
             slot.tokens.append(token)
             slot.remaining -= 1
             pushed += 1
@@ -3893,9 +4031,19 @@ class GenerationEngine:
                 # advance the walker past the emitted token; a completed
                 # match — no grammar-valid continuation left — finishes
                 # the slot exactly like eos (so does a violation, which
-                # only sampling pathologies can produce under the bias)
-                slot.grammar.advance(token)
-                done = slot.grammar.must_stop
+                # only sampling pathologies can produce under the bias).
+                # A walker that RAISES (malformed state, bias/advance
+                # disagreement) poisons only this request — quarantine it
+                # rather than letting the loop catch-all fail the tick's
+                # every other slot (ISSUE 14)
+                try:
+                    slot.grammar.advance(token)
+                    done = slot.grammar.must_stop
+                except Exception as exc:  # noqa: BLE001 — any walker
+                    if chunk and slot.queue is not None:  # failure is
+                        slot.queue.put_nowait(chunk)      # this request's
+                    self._quarantine_slot(slot_idx, slot, "grammar", exc)
+                    return
             if done:
                 slot.active = False    # rest of the chunk is discarded
                 self._release_slot_kv(slot_idx, slot)
@@ -3925,6 +4073,37 @@ class GenerationEngine:
             self.metrics.delta_updown_counter(
                 "app_tpu_sched_tokens_total", float(pushed),
                 model=self.model_name, cls=slot.cls)
+
+    def _quarantine_slot(self, slot_idx: int, slot: _Slot, reason: str,
+                         exc: BaseException) -> None:
+        """Poison-request quarantine (ISSUE 14): one slot whose step
+        output is unusable — the grammar walker blew up, or NaN/inf
+        logits surfaced as an out-of-range token — is excised and failed
+        individually while the tick's other slots keep their tokens and
+        the loop keeps serving. Without this, the only containment is
+        ``_loop``'s catch-all, which fails EVERY outstanding request and
+        rebuilds device state for one poisoned request."""
+        self._quarantined[reason] = self._quarantined.get(reason, 0) + 1
+        if self.logger is not None:
+            self.logger.error(
+                "engine %s: quarantined slot %d (%s): %r",
+                self.model_name, slot_idx, reason, exc)
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_slot_quarantine_total", model=self.model_name,
+                reason=reason)
+        slot.active = False
+        slot.gen += 1
+        slot.inflight = 0
+        self._release_slot_kv(slot_idx, slot)
+        self._finish_slot(slot, "error")
+        if slot.future is not None and not slot.future.done():
+            slot.future.set_exception(exc)
+        if slot.queue is not None:
+            slot.queue.put_nowait(exc)
+            slot.queue = None
+        if slot_idx not in self._free:
+            self._free.append(slot_idx)
 
     def _release_slot_kv(self, slot_idx: int, slot: _Slot) -> None:
         """Return a finished slot's KV footprint to the shared pool

@@ -37,6 +37,8 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from gofr_tpu.tpu import faults
+
 __all__ = [
     "CODEC_RAW", "CODEC_INT8", "FLAG_SESSION", "KVPayload", "KVWireError",
     "codec_for_cfg", "resolve_codec", "leaf_names", "leaf_shape",
@@ -328,10 +330,28 @@ def iter_chunks(data: bytes,
     stream / chunked-HTTP unit). Order-preserving; ``assemble`` is the
     inverse. ``chunk_bytes=None`` resolves the validated
     ``KV_WIRE_CHUNK_BYTES`` knob (default 256 KiB) — large migrations
-    must not head-of-line block the transport behind one giant frame."""
+    must not head-of-line block the transport behind one giant frame.
+
+    Chaos sites ``kv_chunk_truncate`` (drop the tail of the last frame)
+    and ``kv_chunk_corrupt`` (flip a magic byte in the header frame)
+    damage the stream when a fault plan is installed — the receiver's
+    strict ``unpack`` must turn either into a loud :class:`KVWireError`
+    before a damaged handoff reaches the pool."""
     chunk_bytes = resolve_chunk_bytes(chunk_bytes)
-    for start in range(0, len(data), chunk_bytes):
-        yield data[start:start + chunk_bytes]
+    plan = faults.active()
+    end = len(data)
+    corrupt = False
+    if plan.enabled and data:
+        if plan.should("kv_chunk_truncate"):
+            end = max(1, end - max(1, min(64, end // 2)))
+        corrupt = plan.should("kv_chunk_corrupt")
+    for start in range(0, end, chunk_bytes):
+        chunk = data[start:start + chunk_bytes]
+        if corrupt and start == 0:
+            flipped = bytearray(chunk)
+            flipped[0] ^= 0xFF
+            chunk = bytes(flipped)
+        yield chunk
     if not data:
         yield b""
 

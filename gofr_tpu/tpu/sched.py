@@ -101,6 +101,26 @@ def deadline_class(deadline: Optional[float], now: Optional[float] = None,
     return CLASS_STANDARD
 
 
+# Brownout ladder (slo.py BrownoutLadder): which classes a replica sheds
+# at each degradation level, ordered by expendability. Level 1 sheds
+# batch only; deeper levels also act on speculative decode (engine-side)
+# before the watchdog's full shed breaker fires. CLASS_MIGRATED is never
+# shed — its prefill work already happened on another replica.
+BROWNOUT_SHED = (
+    (),                      # level 0: healthy, shed nothing
+    (CLASS_BATCH,),          # level 1: throughput traffic waits
+    (CLASS_BATCH,),          # level 2: + spec-decode γ capped at 1
+    (CLASS_BATCH,),          # level 3: + speculative decode off
+)
+
+
+def brownout_shed_classes(level: int) -> Tuple[str, ...]:
+    """Admission classes a replica refuses at brownout ``level``."""
+    if level <= 0:
+        return BROWNOUT_SHED[0]
+    return BROWNOUT_SHED[min(level, len(BROWNOUT_SHED) - 1)]
+
+
 def parse_class_weights(spec: Optional[str]) -> Dict[str, float]:
     """Parse ``"interactive:4,standard:2,batch:1"`` into a weight map.
 
@@ -201,5 +221,6 @@ __all__ = [
     "CLASS_INTERACTIVE", "CLASS_STANDARD", "CLASS_BATCH", "CLASS_MIGRATED",
     "SLO_CLASSES", "DEFAULT_CLASS_WEIGHTS", "ROLE_CLASS_WEIGHTS",
     "DEFAULT_INTERACTIVE_BUDGET_S", "deadline_class", "parse_class_weights",
-    "role_class_weights", "ClassQueues",
+    "role_class_weights", "ClassQueues", "BROWNOUT_SHED",
+    "brownout_shed_classes",
 ]

@@ -29,6 +29,10 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/batch_lane_smoke.py || ex
 # digest, one live mid-stream migration (token identity, zero
 # re-prefill), one forced autoscale step
 timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || exit 1
+# chaos smoke: seeded mid-stream decode-replica kill on an in-proc
+# fleet — stream completes token-identical (exactly-once indices),
+# one ok resume, every page pool back at its free-list baseline
+timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
 # ragged paged attention smoke: greedy token identity dense vs gather vs
 # the fused Pallas kernel (interpret mode), width-ladder retirement in
 # the ledger, sentinel pages never dereferenced (NaN poisoning)

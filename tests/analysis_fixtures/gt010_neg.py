@@ -1,0 +1,93 @@
+"""GT010 negative fixture: bounded, paced, or escaping retry shapes.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+import asyncio
+import time
+
+
+async def bounded_retry(transport, attempts=3):
+    # the sanctioned shape (tpu/retry.py): a bounded for, no while True
+    last = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return await transport.fetch()
+        except Exception as exc:
+            last = exc
+    raise RuntimeError("attempts exhausted") from last
+
+
+async def paced_poll(broker):
+    # broad except but paced: a persistent failure degrades to a slow
+    # poll, not a hot spin (the batch-lane consumer shape)
+    while True:
+        try:
+            return await broker.subscribe("jobs")
+        except Exception:
+            await asyncio.sleep(1.0)
+
+
+def escaping_loop(queue):
+    # broad except that re-raises a subset: the failure can leave
+    while True:
+        try:
+            queue.pop()
+        except Exception as exc:
+            if isinstance(exc, KeyboardInterrupt):
+                raise
+            time.sleep(0.1)
+
+
+async def state_bounded(self_like, transport):
+    # the loop test can go false — termination by state, not by luck
+    while not self_like.draining:
+        try:
+            await transport.fetch()
+        except Exception:
+            continue
+
+
+async def narrow_handler(transport):
+    # a narrow handler is deliberate routing, not blind swallowing
+    while True:
+        try:
+            return await transport.fetch()
+        except ConnectionError:
+            continue
+
+
+def loop_body_paced(queue, stop):
+    # the sleep lives in the loop body, not the handler: every
+    # iteration is throttled, so the swallow cannot spin hot
+    while True:
+        try:
+            queue.pop()
+        except Exception:
+            pass
+        if stop.wait(1.0):
+            return
+
+
+async def cleanup_in_handler(transport, pending):
+    # the inner try guards error-path cleanup inside a handler that
+    # itself escapes — not a retried operation
+    while True:
+        try:
+            return await transport.fetch()
+        except Exception:
+            for task in pending:
+                try:
+                    task.cancel()
+                except Exception:
+                    pass
+            raise
+
+
+async def try_wraps_loop(transport):
+    # the try is OUTSIDE the loop: a caught failure exits, not retries
+    try:
+        while True:
+            await transport.fetch()
+    except Exception:
+        return None

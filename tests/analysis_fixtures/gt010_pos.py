@@ -1,0 +1,32 @@
+"""GT010 positive fixture: unbounded blind-retry loops.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+
+async def poll_forever(transport):
+    # broad except, no escape, no pacing: a dead peer makes this loop
+    # spin hot forever
+    while True:
+        try:
+            return await transport.fetch()
+        except Exception:
+            continue
+
+
+def drain_queue(queue):
+    # bare except is as broad as it gets; ``pass`` + loop = hot spin
+    while 1:
+        try:
+            queue.pop()
+        except:  # noqa: E722 — fixture exercises the bare form
+            pass
+
+
+async def tuple_handler(client):
+    # Exception hidden inside a tuple is still a broad handler
+    while True:
+        try:
+            await client.send(b"ping")
+        except (ValueError, Exception):
+            client.reconnect()

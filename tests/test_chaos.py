@@ -1,0 +1,650 @@
+"""Chaos plane (ISSUE 14): seeded fault injection and every recovery
+mechanism it exercises.
+
+The load-bearing contracts, in order:
+
+1. FAULTS ARE DETERMINISTIC — a ``FaultPlan`` is a seeded decision
+   table: same seed + same arrival order replays the same failures, so
+   a failing chaos run is reproducible, and the disabled plane is a
+   no-op singleton with zero hot-path state.
+2. RETRY IS BOUNDED BY CONSTRUCTION — ``RetryPolicy`` is a ``for`` over
+   an attempt budget with jittered exponential backoff, an optional
+   wall-clock deadline, and deadline-aware hedging for idempotent legs.
+3. THE CIRCUIT RECOVERS THROUGH A SINGLE-FLIGHT TRIAL — after cooldown
+   exactly one request probes the peer (half-open); its outcome closes
+   or re-opens the circuit, concurrent requests keep fast-failing.
+4. BROWNOUT DEGRADES BEFORE THE BREAKER — sustained watchdog pressure
+   climbs shed-batch → cap-γ → spec-off, and the engine refuses
+   ``batch``-class admissions with a 503-shaped ``BrownoutShed``.
+5. REPLAYED ADOPTS ARE DEDUPED — a retried/hedged KV adopt with the
+   same dedupe id returns the prior stream instead of claiming pages
+   twice.
+6. POISON REQUESTS ARE QUARANTINED — a slot whose step raises (grammar
+   walker failure, out-of-vocab token ids from NaN/inf logits) is
+   excised and failed alone; the tick proceeds for everyone else and
+   the slot's pages free.
+7. DECODE RESUMES ACROSS REPLICA DEATH — a mid-stream crash rebuilds
+   the request on a surviving replica from prompt + emitted tokens:
+   streams complete token-identical (exactly-once indices) across a
+   sweep of fault seeds, and every page pool returns to baseline.
+"""
+
+import asyncio
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.service.circuit_breaker import (STATE_CLOSED, STATE_HALF_OPEN,
+                                              STATE_OPEN, CircuitOpenError,
+                                              _CircuitBreakerService)
+from gofr_tpu.service.client import ServiceError
+from gofr_tpu.slo import (BrownoutLadder, new_brownout,
+                          set_request_deadline)
+from gofr_tpu.tpu import faults, kv_wire
+from gofr_tpu.tpu.cluster import ROLE_BOTH, ClusterRegistry, InProcTransport
+from gofr_tpu.tpu.fleet import FleetRouter
+from gofr_tpu.tpu.generate import BrownoutShed, GenerationEngine
+from gofr_tpu.tpu.retry import RetryBudgetExceeded, RetryPolicy
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.reset()
+
+
+def _make_engine(cfg, params, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 2)
+    kwargs.setdefault("max_len", 32)
+    kwargs.setdefault("prompt_buckets", (8,))
+    kwargs.setdefault("paged_kv", True)
+    kwargs.setdefault("kv_page", 4)
+    engine = GenerationEngine(cfg, params, logger=container.logger,
+                              metrics=container.metrics, **kwargs)
+    return engine, container
+
+
+class _Metrics:
+    def __init__(self):
+        self.counters = {}
+        self.gauges = {}
+
+    def increment_counter(self, name, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        self.counters[key] = self.counters.get(key, 0) + 1
+
+    def set_gauge(self, name, value, **labels):
+        self.gauges[(name, tuple(sorted(labels.items())))] = value
+
+
+# -- 1. the fault plan is deterministic ---------------------------------------
+
+def test_fault_plan_spec_grammar_and_modes():
+    plan = faults.FaultPlan("seed=7, always_site, nth_site:@3, prob:0.5")
+    assert plan.seed == 7
+    assert plan.should("unknown_site") is False
+
+    assert [plan.should("always_site") for _ in range(3)] == [True] * 3
+    assert plan.fired("always_site") == 3
+
+    hits = [plan.should("nth_site") for _ in range(5)]
+    assert hits == [False, False, True, False, False]
+    assert plan.fired("nth_site") == 1 and plan.arrivals("nth_site") == 5
+
+    draws = [plan.should("prob") for _ in range(32)]
+    assert 0 < sum(draws) < 32          # actually probabilistic
+    # same seed + same arrival order -> identical decision sequence
+    replay = faults.FaultPlan("seed=7, prob:0.5")
+    assert [replay.should("prob") for _ in range(32)] == draws
+    other = faults.FaultPlan("seed=8, prob:0.5")
+    assert [other.should("prob") for _ in range(32)] != draws
+
+    plan.disarm("always_site")
+    assert plan.should("always_site") is False
+
+
+def test_fault_plan_raise_arm_and_metrics():
+    metrics = _Metrics()
+    plan = faults.FaultPlan(seed=1, metrics=metrics)
+    plan.arm("boom", nth=2)
+    plan.raise_if("boom")               # arrival 1: passes
+    with pytest.raises(faults.FaultError) as err:
+        plan.raise_if("boom")
+    assert err.value.site == "boom"
+    assert metrics.counters[
+        ("app_tpu_fault_injected_total", (("site", "boom"),))] == 1
+    assert plan.fired() == {"boom": 1}
+
+
+def test_fault_env_install_and_noop_singleton():
+    assert faults.plan_from_env({}) is None
+    assert faults.plan_from_env({"FAULT_PLAN": "  "}) is None
+    plan = faults.plan_from_env({"FAULT_PLAN": "seed=3,x"})
+    assert plan.seed == 3 and plan.should("x")
+
+    assert faults.active() is faults._NOOP
+    faults.install(plan)
+    assert faults.active() is plan
+    faults.reset()
+
+    noop = faults.active()
+    assert noop.enabled is False
+    assert noop.should("x") is False
+    noop.raise_if("x")                  # never raises
+    assert noop.fired() == {} and noop.fired("x") == 0
+    assert noop.arrivals("x") == 0
+
+
+# -- 2. retry is bounded by construction --------------------------------------
+
+def test_retry_bounded_attempts_and_cause():
+    calls = []
+
+    async def fail(attempt):
+        calls.append(attempt)
+        raise ConnectionError(f"attempt {attempt}")
+
+    policy = RetryPolicy(attempts=3, base_s=0.0)
+    with pytest.raises(RetryBudgetExceeded) as err:
+        asyncio.run(policy.run(fail))
+    assert calls == [1, 2, 3]
+    assert isinstance(err.value.__cause__, ConnectionError)
+
+    async def flaky(attempt):
+        if attempt < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert asyncio.run(RetryPolicy(attempts=3, base_s=0.0).run(flaky)) == "ok"
+
+    # non-retryable errors surface immediately, attempt budget unspent
+    calls.clear()
+    with pytest.raises(ConnectionError):
+        asyncio.run(policy.run(
+            fail, retryable=lambda exc: not isinstance(exc,
+                                                       ConnectionError)))
+    assert calls == [1]
+
+
+def test_retry_backoff_jitter_and_deadline():
+    policy = RetryPolicy(attempts=5, base_s=0.1, multiplier=2.0, jitter=0.5)
+    assert policy.backoff_s(1) == 0.0
+    for attempt in (2, 3, 4):
+        raw = 0.1 * 2.0 ** (attempt - 2)
+        for _ in range(16):
+            wait = policy.backoff_s(attempt)
+            assert raw * 0.5 <= wait <= raw
+
+    # the deadline cuts the loop even with attempts remaining
+    calls = []
+
+    async def fail(attempt):
+        calls.append(attempt)
+        raise ConnectionError("down")
+
+    tight = RetryPolicy(attempts=50, base_s=0.2, deadline_s=0.05)
+    start = time.monotonic()
+    with pytest.raises(RetryBudgetExceeded):
+        asyncio.run(tight.run(fail))
+    assert time.monotonic() - start < 2.0
+    assert len(calls) < 50
+
+    retried = []
+    on_retry = lambda attempt, exc: retried.append(attempt)  # noqa: E731
+    with pytest.raises(RetryBudgetExceeded):
+        asyncio.run(RetryPolicy(attempts=2, base_s=0.0).run(
+            fail, on_retry=on_retry))
+    assert retried == [1, 2]
+
+
+def test_hedged_backup_races_slow_primary():
+    async def slow():
+        await asyncio.sleep(5.0)
+        return "primary"
+
+    async def fast():
+        return "backup"
+
+    policy = RetryPolicy(hedge_after_s=0.01)
+    assert asyncio.run(policy.hedged(slow, fast)) == ("backup", True)
+
+    async def quick():
+        return "primary"
+
+    # a fast primary never hedges; disabled hedging goes straight through
+    assert asyncio.run(policy.hedged(quick, fast)) == ("primary", False)
+    assert asyncio.run(
+        RetryPolicy(hedge_after_s=None).hedged(quick, fast)
+    ) == ("primary", False)
+
+    async def boom():
+        raise ConnectionError("primary down")
+
+    async def boom_backup():
+        raise ValueError("backup down")
+
+    with pytest.raises(ConnectionError):
+        asyncio.run(policy.hedged(boom, boom_backup))
+
+
+# -- 3. half-open single-flight circuit recovery ------------------------------
+
+class _FakeInner:
+    def __init__(self):
+        self.base_url = "http://peer"
+        self.logger = None
+        self.metrics = _Metrics()
+        self.tracer = None
+        self.timeout = 1.0
+        self.service_name = "peer"
+        self.fail = True
+        self.calls = 0
+
+    def request(self, method, path, params=None, body=None, headers=None):
+        self.calls += 1
+        if self.fail:
+            raise ServiceError("connection refused")
+
+        class _Resp:
+            status_code = 200
+        return _Resp()
+
+    def health_check(self):
+        return {"status": "UP"}
+
+
+def test_circuit_half_open_single_flight_trial():
+    inner = _FakeInner()
+    service = _CircuitBreakerService(inner, threshold=2, interval=0.03)
+    assert service.state == STATE_CLOSED
+
+    for _ in range(2):
+        with pytest.raises(ServiceError):
+            service.request("GET", "x")
+    assert service.state == STATE_OPEN and service.is_open
+    with pytest.raises(CircuitOpenError):
+        service.request("GET", "x")     # fast-fail, peer untouched
+    assert inner.calls == 2
+
+    time.sleep(0.05)                    # cooldown over: next is the trial
+    assert not service.is_open
+    with pytest.raises(ServiceError):
+        service.request("GET", "x")     # trial fails -> full cooldown
+    assert inner.calls == 3
+    assert service.state == STATE_OPEN and service.is_open
+
+    time.sleep(0.05)
+    inner.fail = False
+    assert service.request("GET", "x").status_code == 200
+    assert inner.calls == 4
+    assert service.state == STATE_CLOSED and not service.is_open
+
+    # a trial in flight keeps everyone else fast-failing
+    service._state = STATE_HALF_OPEN
+    service._trial_inflight = True
+    assert service.is_open
+    with pytest.raises(CircuitOpenError, match="half-open"):
+        service.request("GET", "x")
+    assert inner.calls == 4
+
+    counts = {labels[0][1]: n for (name, labels), n
+              in inner.metrics.counters.items()
+              if name == "app_tpu_circuit_state_total"}
+    assert counts == {"open": 2, "half_open": 2, "closed": 1}
+
+    service.close()                     # API-compat no-op
+    health = service.health_check()
+    assert health["details"]["circuit"] == STATE_HALF_OPEN
+
+
+# -- 4. the brownout ladder ---------------------------------------------------
+
+def test_brownout_ladder_escalates_and_recovers_asymmetrically():
+    metrics = _Metrics()
+    applied = []
+    ladder = BrownoutLadder(applied.append, metrics=metrics,
+                            escalate_after=2, recover_after=3, role="both")
+    assert ladder.observe(True) == 0    # one bad evaluation is noise
+    assert ladder.observe(True) == 1
+    for _ in range(4):
+        ladder.observe(True)
+    assert ladder.level == 3            # climbs one rung per streak, capped
+    for _ in range(6):
+        ladder.observe(False)
+    assert ladder.level == 1            # recovery is slower than escalation
+    assert ladder.observe(True) == 1    # pressure resets the calm streak
+    for _ in range(3):
+        ladder.observe(False)
+    assert ladder.level == 0
+    assert applied == [1, 2, 3, 2, 1, 0]
+    assert metrics.gauges[
+        ("app_tpu_brownout_level", (("role", "both"),))] == 0.0
+    status = ladder.statusz()
+    assert status["level"] == 0 and status["transitions"] == 6
+
+
+def test_new_brownout_factory_gating():
+    container = new_mock_container({"BROWNOUT_ESCALATE_AFTER": "5",
+                                    "BROWNOUT_RECOVER_AFTER": "7",
+                                    "CLUSTER_ROLE": "decode"})
+
+    class _Engine:
+        def set_brownout(self, level):
+            pass
+
+    ladder = new_brownout(container.config, _Engine())
+    assert ladder.escalate_after == 5 and ladder.recover_after == 7
+    assert ladder.role == "decode"
+
+    assert new_brownout(container.config, object()) is None  # no enforcer
+    off = new_mock_container({"BROWNOUT_ENABLED": "false"})
+    assert new_brownout(off.config, _Engine()) is None
+
+
+def test_engine_brownout_gate_sheds_batch_class(setup):
+    cfg, params = setup
+    engine, _ = _make_engine(cfg, params)
+
+    async def run():
+        await engine.start()
+        try:
+            engine.set_brownout(1)
+            engine.set_brownout(99)     # clamps to the ladder top
+            assert engine._brownout == 3
+            engine.set_brownout(1)
+
+            # no request deadline -> batch class -> refused at level 1
+            set_request_deadline(None)
+            with pytest.raises(BrownoutShed):
+                await engine.generate([1, 2, 3], max_new_tokens=2)
+            assert BrownoutShed.status_code == 503
+
+            # interactive traffic still lands while batch sheds
+            set_request_deadline(500.0)
+            try:
+                out = await asyncio.wait_for(engine.generate(
+                    [1, 2, 3], max_new_tokens=2), 60.0)
+            finally:
+                set_request_deadline(None)
+            assert len(out) == 2
+
+            stats = engine.stats()
+            assert stats["resilience"]["brownout_level"] == 1
+
+            engine.set_brownout(0)      # recovery reopens batch admission
+            out = await asyncio.wait_for(engine.generate(
+                [1, 2, 3], max_new_tokens=2), 60.0)
+            assert len(out) == 2
+            assert "resilience" not in engine.stats()  # sparse when clean
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+# -- 5. replayed adopts are deduped -------------------------------------------
+
+def test_adopt_kv_dedupe_returns_prior_stream_once(setup):
+    cfg, params = setup
+
+    async def run():
+        source, _ = _make_engine(cfg, params)
+        engine, _ = _make_engine(cfg, params)
+        await engine.start()
+        try:
+            payload = await source.prefill_export([1, 2, 3, 4, 5])
+            baseline = engine._pool.free_pages
+            first = await engine.adopt_kv(payload, 4, dedupe="handoff-1")
+            claimed = baseline - engine._pool.free_pages
+            assert claimed > 0
+
+            # the replay (a retry/hedge landing twice) is answered from
+            # the ledger: same stream object, zero additional pages
+            replay = await engine.adopt_kv(payload, 4, dedupe="handoff-1")
+            assert replay is first
+            assert baseline - engine._pool.free_pages == claimed
+            assert engine.stats()["resilience"]["adopt_dedup_hits"] == 1
+
+            # a different id is a different handoff
+            other = await engine.adopt_kv(payload, 4, dedupe="handoff-2")
+            assert other is not first
+
+            for stream in (first, other):
+                tokens = [t async for t in stream]
+                assert len(tokens) == 4
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+# -- 6. poison-request quarantine ---------------------------------------------
+
+def test_nan_logits_quarantines_one_slot_others_finish(setup):
+    cfg, params = setup
+    engine, _ = _make_engine(cfg, params)
+    plan = faults.FaultPlan(seed=5).arm("nan_logits", nth=1)
+
+    async def run():
+        await engine.start()
+        faults.install(plan)
+        try:
+            results = await asyncio.wait_for(asyncio.gather(
+                engine.generate([1, 2, 3], max_new_tokens=6),
+                engine.generate([4, 5, 6], max_new_tokens=6),
+                return_exceptions=True), 60.0)
+        finally:
+            faults.reset()
+            await engine.stop()
+
+        assert plan.fired("nan_logits") == 1
+        failed = [r for r in results if isinstance(r, BaseException)]
+        finished = [r for r in results if not isinstance(r, BaseException)]
+        assert len(failed) == 1, results     # exactly the poisoned slot
+        assert "vocab" in str(failed[0]) or "token" in str(failed[0])
+        assert len(finished) == 1 and len(finished[0]) == 6
+        stats = engine.stats()
+        assert stats["resilience"]["quarantined"] == {"nan_logits": 1}
+        assert stats["free_slots"] == 2      # the excised slot was freed
+
+    asyncio.run(run())
+
+
+class _BoomGrammar:
+    """Walker whose ``advance`` detonates; ``bias_row`` stays benign so
+    the tick dispatcher (which biases logits for constrained slots)
+    keeps working until the emitted token reaches the walker."""
+
+    must_stop = False
+
+    def __init__(self, vocab_size):
+        self._row = np.zeros((vocab_size,), np.float32)
+
+    def bias_row(self):
+        return self._row
+
+    def advance(self, token):
+        raise ValueError("walker exploded mid-decode")
+
+
+def test_grammar_failure_quarantines_only_its_request(setup):
+    cfg, params = setup
+    engine, _ = _make_engine(cfg, params)
+
+    async def run():
+        await engine.start()
+        try:
+            victim = await engine.generate_stream([1, 2, 3],
+                                                  max_new_tokens=24)
+            bystander = asyncio.ensure_future(asyncio.wait_for(
+                engine.generate([4, 5, 6], max_new_tokens=8), 60.0))
+            first = await asyncio.wait_for(victim.__anext__(), 60.0)
+            assert isinstance(first, int)
+
+            active = [s for s in engine._slots if s.active]
+            assert active
+            # poison the victim's walker; the next delivered token hits
+            # the advance() breaker and quarantines exactly that slot
+            for slot in active:
+                if slot.queue is victim._queue:
+                    slot.grammar = _BoomGrammar(cfg.vocab_size)
+                    break
+            else:
+                raise AssertionError("victim slot not found")
+
+            with pytest.raises(ValueError, match="walker exploded"):
+                async for _ in victim:
+                    pass
+            out = await bystander
+            assert len(out) == 8
+            assert engine.stats()["resilience"]["quarantined"] == \
+                {"grammar": 1}
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+
+
+# -- wire faults fail loudly, never quietly -----------------------------------
+
+def test_chunk_faults_surface_as_kv_wire_errors(setup):
+    cfg, params = setup
+
+    async def run():
+        source, _ = _make_engine(cfg, params)
+        payload = await source.prefill_export([1, 2, 3, 4, 5])
+        blob = kv_wire.pack(payload)
+
+        faults.install(faults.FaultPlan("kv_chunk_truncate"))
+        truncated = kv_wire.assemble(kv_wire.iter_chunks(blob, 64))
+        assert len(truncated) < len(blob)
+        with pytest.raises(kv_wire.KVWireError):
+            kv_wire.unpack(truncated)
+
+        faults.install(faults.FaultPlan("kv_chunk_corrupt"))
+        corrupt = kv_wire.assemble(kv_wire.iter_chunks(blob, 64))
+        assert len(corrupt) == len(blob) and corrupt != blob
+        with pytest.raises(kv_wire.KVWireError):
+            kv_wire.unpack(corrupt)
+
+        faults.reset()
+        clean = kv_wire.assemble(kv_wire.iter_chunks(blob, 64))
+        assert clean == blob
+
+    asyncio.run(run())
+
+
+# -- 7. resumable decode across a seed sweep ----------------------------------
+
+async def _drain_to_baseline(engines, baseline, deadline_s=10.0):
+    deadline = time.monotonic() + deadline_s
+    while True:
+        now = {n: e._pool.free_pages for n, e in engines.items()}
+        if now == baseline:
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(f"leaked KV pages: {now} != {baseline}")
+        await asyncio.sleep(0.05)
+
+
+def test_decode_resume_seed_sweep_is_token_identical(setup):
+    """Eight seeded mid-decode crashes, each at a different token index:
+    every stream completes token-identical to the undisturbed reference,
+    every crash is healed by exactly one resume, and every page pool
+    drains back to its free-list baseline."""
+    cfg, params = setup
+    prompt, budget = [9, 8, 7], 8
+
+    async def reference():
+        engine, _ = _make_engine(cfg, params)
+        await engine.start()
+        try:
+            return await asyncio.wait_for(engine.generate(
+                prompt, max_new_tokens=budget), 60.0)
+        finally:
+            await engine.stop()
+
+    async def sweep(ref):
+        engines = {}
+        cluster = ClusterRegistry()
+        for name in ("d0", "d1", "d2"):
+            engine, _ = _make_engine(cfg, params)
+            engines[name] = engine
+            cluster.register(name, ROLE_BOTH, InProcTransport(engine))
+        router = FleetRouter(cluster)
+        for engine in engines.values():
+            await engine.start()
+        try:
+            baseline = {n: e._pool.free_pages for n, e in engines.items()}
+            for seed in range(8):
+                crash_at = 2 + seed % 5      # token indices 2..6
+                plan = faults.FaultPlan(
+                    f"crash_mid_decode:@{crash_at}", seed=seed)
+                faults.install(plan)
+                session = await router.generate_stream(
+                    prompt, max_new_tokens=budget)
+                source = session.replica_name
+                tokens = []
+                async for token in session:
+                    tokens.append(token)
+                faults.reset()
+                assert plan.fired("crash_mid_decode") == 1, seed
+                assert tokens == ref, \
+                    f"seed {seed}: {tokens} != {ref}"
+                assert session.replica_name != source, seed
+                await _drain_to_baseline(engines, baseline)
+            resumes = router.fleet_stats()["resumes"]
+            assert resumes == {"ok": 8, "failed": 0}
+        finally:
+            faults.reset()
+            for engine in engines.values():
+                await engine.stop()
+
+    ref = asyncio.run(reference())
+    assert len(ref) == budget
+    asyncio.run(sweep(ref))
+
+
+def test_resume_budget_exhausts_and_surfaces_the_fault(setup):
+    """A replica that keeps dying burns the per-session resume budget
+    (3) and then surfaces the failure instead of retrying forever."""
+    cfg, params = setup
+
+    async def run():
+        engines = {}
+        cluster = ClusterRegistry()
+        for name in ("d0", "d1"):
+            engine, _ = _make_engine(cfg, params)
+            engines[name] = engine
+            cluster.register(name, ROLE_BOTH, InProcTransport(engine))
+        router = FleetRouter(cluster)
+        for engine in engines.values():
+            await engine.start()
+        faults.install(faults.FaultPlan("crash_mid_decode"))  # every token
+        try:
+            session = await router.generate_stream([9, 8, 7],
+                                                   max_new_tokens=6)
+            with pytest.raises(faults.FaultError):
+                async for _ in session:
+                    pass
+            resumes = router.fleet_stats()["resumes"]
+            assert resumes["ok"] == router.resume_budget
+            assert resumes["failed"] == 1      # the budget refusal
+        finally:
+            faults.reset()
+            for engine in engines.values():
+                await engine.stop()
+
+    asyncio.run(run())

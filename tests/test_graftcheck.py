@@ -248,6 +248,33 @@ def test_gt009_negative_guarded_bounded_and_unresolvable_are_clean():
     assert report.exit_code == 0
 
 
+# -- GT010 unbounded retry ----------------------------------------------------
+
+def test_gt010_positive_flags_blind_retry_loops():
+    report = scan("gt010_pos.py", "GT010")
+    got = keys(report)
+    assert "unbounded retry in poll_forever" in got
+    assert "unbounded retry in drain_queue" in got      # bare except
+    assert "unbounded retry in tuple_handler" in got    # (X, Exception)
+    assert all(f.rule == "GT010" and f.severity == "error"
+               for f in report.new_findings)
+
+
+def test_gt010_finding_anchors_at_the_handler_line():
+    report = scan("gt010_pos.py", "GT010")
+    by_key = {f.key: f for f in report.new_findings}
+    rendered = by_key["unbounded retry in poll_forever"].render()
+    assert "gt010_pos.py" in rendered and "GT010" in rendered
+    # anchored at the except line, inside the function body
+    assert by_key["unbounded retry in poll_forever"].line > 7
+
+
+def test_gt010_negative_bounded_paced_and_escaping_are_clean():
+    report = scan("gt010_neg.py", "GT010")
+    assert report.new_findings == []
+    assert report.exit_code == 0
+
+
 # -- engine mechanics --------------------------------------------------------
 
 def _write_module(tmp_path, body):
@@ -373,7 +400,7 @@ def test_cli_list_rules_covers_catalog():
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
         {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007",
-         "GT008", "GT009"}
+         "GT008", "GT009", "GT010"}
 
 
 def test_lint_metrics_shim_still_works():
