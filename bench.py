@@ -1534,6 +1534,7 @@ def _llama_disagg_bench(on_tpu: bool):
 
     from gofr_tpu.clusterz import build_clusterz
     from gofr_tpu.container import new_mock_container
+    from gofr_tpu.metrics.timeseries import TimeSeriesStore
     from gofr_tpu.models import llama
     from gofr_tpu.tpu.cluster import (ClusterRegistry, DisaggRouter,
                                       InProcTransport)
@@ -1594,6 +1595,11 @@ def _llama_disagg_bench(on_tpu: bool):
 
     async def run_disagg():
         prefill_eng, decode_eng = build(False), build(True)
+        # sampled decode-tick anatomy rides the round's artifact: the
+        # bench decode path is where the unsampled-tick overhead bound
+        # is priced, so phase timings land in the ledger diff
+        telemetry = TimeSeriesStore(tick_sample=8)
+        decode_eng.attach_telemetry(telemetry, every=telemetry.tick_sample)
         cluster = ClusterRegistry()
         cluster.register("p0", "prefill", InProcTransport(prefill_eng))
         cluster.register("d0", "decode", InProcTransport(decode_eng))
@@ -1609,13 +1615,16 @@ def _llama_disagg_bench(on_tpu: bool):
             # the ledger diff, not just in a failing endpoint later
             fleet = await build_clusterz(cluster, router=router)
             hbm = decode_eng.hbm_attribution()
-            return result + (router.stats(), decode_eng.stats(), fleet, hbm)
+            timez = {"ticks": telemetry.tick_anatomy(limit=4),
+                     "memory": telemetry.memory_info()}
+            return result + (router.stats(), decode_eng.stats(), fleet,
+                             hbm, timez)
         finally:
             await decode_eng.stop()
 
     mono_outs, mono_tok_s, mono_ttft_ms = asyncio.run(run_monolithic())
     (dis_outs, dis_tok_s, dis_ttft_ms, router_stats,
-     decode_stats, fleet, hbm) = asyncio.run(run_disagg())
+     decode_stats, fleet, hbm, timez) = asyncio.run(run_disagg())
 
     requests = router_stats["requests"] or 1
     return {
@@ -1650,6 +1659,7 @@ def _llama_disagg_bench(on_tpu: bool):
             "unattributed_bytes": hbm["unattributed_bytes"],
             "device_seconds": hbm.get("device_seconds"),
         },
+        "timez": timez,
         "note": ("in-proc transport: codec + adopt scatter priced, "
                  "network not; disagg TTFT carries the transfer leg. "
                  "Compare monolithic vs disagg within this run, not "

@@ -233,6 +233,7 @@ def build_app():
     app.enable_varz()           # windowed SLO/goodput/saturation numbers
     app.enable_xlaz()           # compile ledger + prompt-bucket fit view
     app.enable_hbmz()           # device-memory attribution + watchdog HBM
+    app.enable_timez()          # multi-res series + anomalies + tick anatomy
     app.enable_profiler()       # duration-capped on-demand XLA captures
 
     @app.on_startup

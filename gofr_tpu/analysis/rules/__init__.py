@@ -16,6 +16,8 @@ from gofr_tpu.analysis.rules.gt008_label_cardinality import \
     LabelCardinalityRule
 from gofr_tpu.analysis.rules.gt009_cron import CronReentrancyRule
 from gofr_tpu.analysis.rules.gt010_retry import UnboundedRetryRule
+from gofr_tpu.analysis.rules.gt011_telemetry import \
+    UnboundedTelemetryBufferRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -28,6 +30,7 @@ ALL_RULES = (
     LabelCardinalityRule,
     CronReentrancyRule,
     UnboundedRetryRule,
+    UnboundedTelemetryBufferRule,
 )
 
 
@@ -35,13 +38,15 @@ def default_rules(select: Optional[Sequence[str]] = None,
                   **options) -> List[Rule]:
     """Instantiate the rule set, optionally filtered to ``select`` ids.
     ``options`` are forwarded to rules that accept them (GT005 takes
-    ``docs_catalog``)."""
+    ``docs_catalog``, GT011 takes ``scope_all``)."""
     rules: List[Rule] = []
     for cls in ALL_RULES:
         if select and cls.rule_id not in select:
             continue
         if cls is MetricDisciplineRule and "docs_catalog" in options:
             rules.append(cls(docs_catalog=options["docs_catalog"]))
+        elif cls is UnboundedTelemetryBufferRule and "scope_all" in options:
+            rules.append(cls(scope_all=options["scope_all"]))
         else:
             rules.append(cls())
     return rules

@@ -256,6 +256,11 @@ class App:
         from gofr_tpu.hbmz import enable_hbmz
         enable_hbmz(self, prefix)
 
+    # -- time-series telemetry timez (no reference analog; timez.py) --------
+    def enable_timez(self, prefix: str = "/debug/timez") -> None:
+        from gofr_tpu.timez import enable_timez
+        enable_timez(self, prefix)
+
     # -- external DB injection (externalDB.go:5-39) -------------------------
     def add_mongo(self, client=None) -> None:
         if client is None:
@@ -424,6 +429,24 @@ class App:
                              "(seed %d)", os.environ.get("FAULT_PLAN"),
                              plan.seed)
 
+        # continuous telemetry plane (ISSUE 16): bounded time-series
+        # store + sampler over the serving signals (TELEMETRY_ENABLED,
+        # default on). Built before the watchdog so the change-point
+        # detector can feed it a health signal; the engine's sampled
+        # tick anatomy attaches to the same store.
+        from gofr_tpu.metrics.timeseries import new_timeseries
+        self.container.telemetry = new_timeseries(
+            self.config, slo=self.container.slo, tpu=self.container.tpu,
+            container=self.container, metrics=self.container.metrics,
+            logger=self.logger)
+        if self.container.telemetry is not None:
+            if self.container.tpu is not None and \
+                    hasattr(self.container.tpu, "attach_telemetry"):
+                self.container.tpu.attach_telemetry(
+                    self.container.telemetry,
+                    every=self.container.telemetry.tick_sample)
+            self.container.telemetry.start()
+
         # degradation watchdog over the SLO rolling windows (slo.py);
         # SLO_WATCHDOG_ENABLED=false opts out entirely. The executor's
         # compile ledger (when present) feeds its recompile-storm signal.
@@ -433,6 +456,11 @@ class App:
             logger=self.logger,
             ledger=getattr(self.container.tpu, "ledger", None))
         if self.container.watchdog is not None:
+            if self.container.telemetry is not None:
+                # watch-listed telemetry anomalies (goodput cliff,
+                # padding spike) become named watchdog reasons
+                self.container.watchdog.anomaly_fn = \
+                    self.container.telemetry.watchdog_reasons
             # brownout ladder (ISSUE 14): graduated shedding fed by the
             # watchdog's evaluations, enforced by the engine — only wired
             # when the serving engine can actually act on a level
@@ -506,6 +534,8 @@ class App:
                 grace_s=self._shutdown_grace)
         if self.container.watchdog is not None:
             await self.container.watchdog.stop()
+        if self.container.telemetry is not None:
+            await self.container.telemetry.stop()
         for task in self._tasks:
             task.cancel()
         self._tasks.clear()

@@ -149,6 +149,11 @@ async def build_clusterz(cluster, router=None,
             autoscaler = getattr(router, "autoscaler", None)
             if autoscaler is not None:
                 out["fleet"]["autoscaler"] = autoscaler.status()
+            # fleet series rollup (ISSUE 16): the cursor-pulled window
+            # means the autoscaler acts on, next to the decision log
+            rollup = getattr(router, "rollup", None)
+            if rollup is not None:
+                out["fleet"]["telemetry"] = rollup.statusz()
     if watchdog is not None:
         out["watchdog"] = watchdog.statusz()
     return out

@@ -59,6 +59,10 @@ class Container:
         # the DisaggRouter serving that cluster, when one exists — the
         # clusterz/tracez pages discover it here (ISSUE 10)
         self.cluster_router = None
+        # continuous telemetry plane (ISSUE 16): the bounded time-series
+        # store + anomaly detector, created by App.start (TELEMETRY_*);
+        # /debug/timez and the statusz sparkline section read it here
+        self.telemetry = None
 
         self._start_time = time.time()
 
@@ -399,6 +403,15 @@ class Container:
             "app_tpu_adopt_dedup_total",
             "replayed KV adoptions answered from the dedupe ledger, "
             "per model — a retry/hedge landed twice and was deduped")
+        # continuous telemetry plane (ISSUE 16): change-point detector
+        # verdicts over the in-process time-series store — one increment
+        # per anomaly *raised* (not per sample), so the counter rate is
+        # the replica's regime-change rate, not its sampling rate
+        metrics.new_counter(
+            "app_tpu_anomaly_total",
+            "telemetry anomalies raised by the change-point detector, "
+            "per (signal, direction) — a goodput cliff or padding spike "
+            "that survived the detector's hysteresis")
         metrics.new_counter(
             "app_tpu_fleet_resume_total",
             "mid-stream decode resumes by result (ok|no_ctx|budget|"

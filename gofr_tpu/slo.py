@@ -255,7 +255,8 @@ class Watchdog:
                  role: str = "both",
                  hbm_fn: Any = None,
                  max_hbm_occupancy: Optional[float] = None,
-                 brownout: Any = None):
+                 brownout: Any = None,
+                 anomaly_fn: Any = None):
         self.slo = slo
         self.metrics = metrics
         self.logger = logger
@@ -285,6 +286,13 @@ class Watchdog:
         # batch → cap spec γ → spec off) BEFORE the hysteresis-gated
         # DEGRADED flip pulls it from the load balancer entirely
         self.brownout = brownout
+        # telemetry anomaly signal (ISSUE 16): ``anomaly_fn`` returns a
+        # list of reason strings for active change-point anomalies on
+        # watch-listed signals (TimeSeriesStore.watchdog_reasons). Like
+        # the recompile/HBM signals it is independent of min_requests —
+        # a goodput cliff detected against the replica's own baseline
+        # names the offending signal right here in statusz.
+        self.anomaly_fn = anomaly_fn
         self.window_s = window_s
         self.interval_s = interval_s
         self.hysteresis = max(1, int(hysteresis))
@@ -331,6 +339,15 @@ class Watchdog:
                 reasons.append(
                     f"hbm occupancy {occupancy:.3f} > "
                     f"{self.max_hbm_occupancy}")
+        # telemetry anomalies: the change-point detector already applied
+        # its own hysteresis, so every active watch-listed anomaly is a
+        # sustained regime change, not a noisy sample
+        if self.anomaly_fn is not None:
+            try:
+                anomaly_reasons = self.anomaly_fn()
+            except Exception:
+                anomaly_reasons = ()
+            reasons.extend(anomaly_reasons)
         self._last_reasons = reasons
         if self.brownout is not None:
             self.brownout.observe(bool(reasons))
@@ -469,6 +486,15 @@ class BrownoutLadder:
     def _set(self, level: int) -> None:
         previous, self.level = self.level, level
         self.transitions += 1
+        # chaos-plane trace visibility (ISSUE 16): when a transition
+        # happens under an active span (e.g. a watchdog evaluation
+        # traced by a test, or a request that tripped the ladder), the
+        # level change is stamped on it
+        from gofr_tpu.trace.tracer import current_span
+        span = current_span()
+        if span is not None:
+            span.add_event("brownout.level", previous=previous,
+                           level=level, role=self.role)
         if self.apply_fn is not None:
             try:
                 self.apply_fn(level)

@@ -38,6 +38,17 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
     if watchdog is not None:
         status["watchdog"] = watchdog.statusz()
 
+    # continuous telemetry plane (ISSUE 16): compact sparkline view of
+    # the time-series store plus any active anomalies — the offending
+    # signal shows up both here and in the watchdog's last_reasons; the
+    # full aligned series live on /debug/timez
+    telemetry = getattr(container, "telemetry", None)
+    if telemetry is not None:
+        try:
+            status["telemetry"] = telemetry.statusz()
+        except Exception as exc:   # a telemetry bug must not 500 statusz
+            status["telemetry"] = {"error": repr(exc)}
+
     # on-demand profiler (ISSUE 10): is a capture running, and where did
     # the last one land — surfaced here so trace artifacts are findable
     # without grepping logs

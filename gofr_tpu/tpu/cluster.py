@@ -288,6 +288,16 @@ class InProcTransport:
                 out["prefix_digest"] = digest
         return out
 
+    async def telemetry_delta(
+            self, cursor: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Cursor-based pull of the replica's telemetry samples (ISSUE
+        16): the fleet rollup calls this from ``FleetRouter.refresh``.
+        None when the replica has no telemetry store attached."""
+        store = getattr(self.engine, "telemetry", None)
+        if store is None:
+            return None
+        return store.delta(cursor)
+
     async def tracez(self, trace_id: str) -> List[Dict[str, Any]]:
         recorder = getattr(self.engine, "recorder", None)
         if recorder is None:
@@ -450,6 +460,18 @@ class HTTPTransport:
         peer = response.json()
         return {"kind": self.kind, "statusz": peer,
                 "health": "UP"}
+
+    async def telemetry_delta(
+            self, cursor: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Cursor-based telemetry pull over the peer's ``/debug/timez``
+        endpoint (ISSUE 16). None when the peer has no timez surface or
+        no telemetry store — the rollup simply skips the replica."""
+        params: Dict[str, Any] = {"cursor": int(cursor)
+                                  if cursor is not None else 0}
+        response = await self.service.aget("/debug/timez", params=params)
+        if not response.ok:
+            return None
+        return response.json().get("delta")
 
     async def tracez(self, trace_id: str) -> List[Dict[str, Any]]:
         response = await self.service.aget(

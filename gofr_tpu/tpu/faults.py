@@ -50,6 +50,8 @@ import random
 import threading
 from typing import Dict, Optional, Tuple
 
+from gofr_tpu.trace.tracer import current_span
+
 __all__ = [
     "FaultError",
     "FaultPlan",
@@ -142,9 +144,16 @@ class FaultPlan:
                 fire = self._rng.random() < value
             if fire:
                 self._fired[site] = self._fired.get(site, 0) + 1
-        if fire and self.metrics is not None:
-            self.metrics.increment_counter(
-                "app_tpu_fault_injected_total", site=site)
+        if fire:
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_tpu_fault_injected_total", site=site)
+            # chaos-plane trace visibility (ISSUE 16): the injection
+            # stamps the surrounding span, so a tracez/chaos trace shows
+            # WHY a phase stalled — which site fired, at what arrival
+            span = current_span()
+            if span is not None:
+                span.add_event("fault.injected", site=site, arrival=n)
         return fire
 
     def raise_if(self, site: str) -> None:

@@ -35,14 +35,158 @@ import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from gofr_tpu.metrics.timeseries import SeriesRing
 from gofr_tpu.tpu import faults
 from gofr_tpu.tpu.cluster import (DisaggRouter, NoReplicaAvailable,
                                   Replica, ROLE_DECODE, STATE_DRAINING,
                                   STATE_READY, _RelayStream)
 from gofr_tpu.tpu.prefix_cache import chain_hashes
 
-__all__ = ["FleetPrefixIndex", "FleetSession", "FleetRouter",
-           "Autoscaler"]
+__all__ = ["FleetPrefixIndex", "FleetSeriesRollup", "FleetSession",
+           "FleetRouter", "Autoscaler"]
+
+
+class FleetSeriesRollup:
+    """Fleet-wide short-window series built from replica telemetry
+    deltas (ISSUE 16).
+
+    ``FleetRouter.refresh`` pulls each decode replica's
+    ``telemetry_delta(cursor)`` (cursor-based, bounded payload) and
+    feeds the samples here; the :class:`Autoscaler` then reads
+    *window means* instead of instantaneous probe sums. That closes the
+    flap the probe sweep had: one stale or dead probe used to silently
+    drop its queue-depth contribution from the sum, reading as a fleet
+    gone idle and starting a scale-down streak. A window mean keeps the
+    missing replica's recent samples contributing until the window
+    drains — a probe miss decays instead of cliffing.
+
+    Memory contract: per replica, only :data:`SIGNALS` (3 signals) ×
+    one 1s ring of ``capacity`` buckets (default 120) — ~replicas × 3 ×
+    120 × 5 floats, independent of uptime. Timestamps in deltas are the
+    *source* process's monotonic clock; ``ingest`` re-stamps them onto
+    the puller's clock preserving sample spacing."""
+
+    SIGNALS = ("queue_depth", "kv_occupancy", "goodput_tok_s")
+
+    def __init__(self, window_s: float = 30.0, capacity: int = 120):
+        self.window_s = float(window_s)
+        self.capacity = int(capacity)
+        self._rings: Dict[str, Dict[str, SeriesRing]] = {}
+        self._cursors: Dict[str, Optional[int]] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._misses: Dict[str, int] = {}
+        self._pulls = 0
+        self._resets = 0
+
+    def cursor(self, name: str) -> Optional[int]:
+        """The cursor to hand the replica's next ``telemetry_delta``."""
+        return self._cursors.get(name)
+
+    def ingest(self, name: str, delta: Dict[str, Any],
+               now: Optional[float] = None) -> int:
+        """Fold one delta payload into the replica's rings; returns the
+        number of samples folded."""
+        if now is None:
+            now = time.monotonic()
+        samples = delta.get("samples") or []
+        if delta.get("reset"):
+            self._resets += 1
+            # the cursor fell off the source's log (or the replica
+            # restarted): the carried samples are a fresh start, so the
+            # stale window must not blend with them
+            self._rings.pop(name, None)
+        self._cursors[name] = delta.get("cursor")
+        self._pulls += 1
+        self._last_seen[name] = now
+        if not samples:
+            return 0
+        rings = self._rings.get(name)
+        if rings is None:
+            rings = self._rings[name] = {
+                sig: SeriesRing(1.0, self.capacity) for sig in self.SIGNALS}
+        # re-stamp: align the newest source timestamp to the puller's
+        # `now`, shifting every sample by the same offset
+        offset = now - float(samples[-1]["t"])
+        folded = 0
+        for sample in samples:
+            at = float(sample["t"]) + offset
+            values = sample.get("values") or {}
+            for sig in self.SIGNALS:
+                value = values.get(sig)
+                if value is not None:
+                    rings[sig].add(float(value), at)
+                    folded += 1
+        return folded
+
+    def note_miss(self, name: str, now: Optional[float] = None) -> None:
+        """A refresh pass could not reach the replica. The rings keep
+        their samples — the window mean decays them out naturally."""
+        self._misses[name] = self._misses.get(name, 0) + 1
+
+    def drop(self, name: str) -> None:
+        """The replica left the registry for good."""
+        self._rings.pop(name, None)
+        self._cursors.pop(name, None)
+        self._last_seen.pop(name, None)
+        self._misses.pop(name, None)
+
+    def fresh(self, now: Optional[float] = None) -> bool:
+        """True when at least one replica delivered a delta inside the
+        window — the autoscaler's gate before trusting the means."""
+        if now is None:
+            now = time.monotonic()
+        return any(now - at <= self.window_s
+                   for at in self._last_seen.values())
+
+    def signals(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Fleet window means: queue depth *summed* across replicas,
+        occupancy the fleet *max*, goodput summed — each from the 30s
+        window, so a missed probe decays instead of zeroing."""
+        if now is None:
+            now = time.monotonic()
+        queue_depth = 0.0
+        queue_seen = False
+        occupancy: Optional[float] = None
+        goodput = 0.0
+        contributing = 0
+        for name, rings in self._rings.items():
+            depth = rings["queue_depth"].window_mean(self.window_s, now)
+            occ = rings["kv_occupancy"].window_mean(self.window_s, now)
+            good = rings["goodput_tok_s"].window_mean(self.window_s, now)
+            if depth is None and occ is None and good is None:
+                continue
+            contributing += 1
+            if depth is not None:
+                queue_depth += depth
+                queue_seen = True
+            if occ is not None:
+                occupancy = occ if occupancy is None else max(occupancy, occ)
+            if good is not None:
+                goodput += good
+        return {
+            "queue_depth": queue_depth if queue_seen else None,
+            "occupancy": occupancy,
+            "goodput_tok_s": goodput,
+            "contributing": contributing,
+            "window_s": self.window_s,
+        }
+
+    def statusz(self, now: Optional[float] = None) -> Dict[str, Any]:
+        if now is None:
+            now = time.monotonic()
+        return {
+            "window_s": self.window_s,
+            "fresh": self.fresh(now),
+            "pulls": self._pulls,
+            "resets": self._resets,
+            "misses": dict(self._misses),
+            "replicas": {
+                name: {"age_s": round(now - self._last_seen[name], 3)
+                       if name in self._last_seen else None,
+                       "cursor": self._cursors.get(name)}
+                for name in self._rings},
+            "signals": self.signals(now),
+        }
 
 
 class FleetPrefixIndex:
@@ -236,6 +380,10 @@ class FleetRouter(DisaggRouter):
                          tracer=tracer)
         self.index = FleetPrefixIndex()
         self.digest_entries = int(digest_entries)
+        # fleet series rollup (ISSUE 16): always created — replicas
+        # without a telemetry store simply never feed it, and the
+        # autoscaler falls back to the probe sweep while it is empty
+        self.rollup = FleetSeriesRollup()
         # the example wiring attaches its Autoscaler here so clusterz
         # can fold its status into the fleet rollup
         self.autoscaler: Optional[Autoscaler] = None
@@ -265,11 +413,13 @@ class FleetRouter(DisaggRouter):
             observe = getattr(replica.transport, "observe", None)
             if observe is None or not replica.transport.available():
                 self.index.drop(name)
+                self.rollup.note_miss(name)
                 continue
             try:
                 obs = await observe()
             except Exception:
                 self.index.drop(name)
+                self.rollup.note_miss(name)
                 continue
             digest = obs.get("prefix_digest") or \
                 (obs.get("statusz") or {}).get("prefix_digest")
@@ -277,6 +427,19 @@ class FleetRouter(DisaggRouter):
                 self.index.update(name, digest)
             else:
                 self.index.drop(name)
+            # fleet series rollup (ISSUE 16): cursor-based telemetry
+            # pull rides the same probe pass — bounded payload, and a
+            # failed pull is a miss, never a refresh failure
+            pull = getattr(replica.transport, "telemetry_delta", None)
+            if pull is not None:
+                try:
+                    delta = await pull(self.rollup.cursor(name))
+                except Exception:
+                    delta = None
+                if delta is not None:
+                    self.rollup.ingest(name, delta)
+                else:
+                    self.rollup.note_miss(name)
         return self.index.stats()
 
     async def generate_stream(self, prompt_ids, max_new_tokens: int,
@@ -756,7 +919,11 @@ class Autoscaler:
 
     async def _gather(self) -> Dict[str, Any]:
         """Fleet signal snapshot. ``signals_fn`` (tests, exotic
-        topologies) overrides the default probe sweep."""
+        topologies) overrides everything; otherwise the router's series
+        rollup (30s window means, ISSUE 16) is preferred when fresh —
+        window means decay a dead probe's contribution instead of
+        zeroing it, which is what used to flap the scaler — with the
+        instantaneous probe sweep as the fallback."""
         if self._signals_fn is not None:
             out = self._signals_fn()
             if asyncio.iscoroutine(out):
@@ -765,15 +932,30 @@ class Autoscaler:
                     "occupancy": out.get("occupancy"),
                     "hbm": out.get("hbm"),
                     "decode_replicas": int(out.get("decode_replicas", 0))}
+        decode = sum(
+            1 for replica in self.registry._replicas.values()
+            if replica.serves(ROLE_DECODE)
+            and replica.state == STATE_READY)
+        hbm: Optional[float] = None
+        if self.container is not None:
+            from gofr_tpu.hbmz import hbm_occupancy
+            hbm = hbm_occupancy(self.container)
+        rollup = getattr(self.router, "rollup", None) \
+            if self.router is not None else None
+        if rollup is not None and rollup.fresh():
+            means = rollup.signals()
+            if means["queue_depth"] is not None:
+                return {"queue_depth": int(round(means["queue_depth"])),
+                        "occupancy": means["occupancy"],
+                        "hbm": hbm, "decode_replicas": decode,
+                        "source": "rollup"}
         queue_depth = 0
         occupancy: Optional[float] = None
-        decode = 0
         for name in self.registry.replicas():
             replica = self.registry._replicas[name]
             if not replica.serves(ROLE_DECODE) or \
                     replica.state != STATE_READY:
                 continue
-            decode += 1
             observe = getattr(replica.transport, "observe", None)
             if observe is None:
                 continue
@@ -789,12 +971,9 @@ class Autoscaler:
                 occ = float(pool["occupancy"])
                 occupancy = occ if occupancy is None \
                     else max(occupancy, occ)
-        hbm: Optional[float] = None
-        if self.container is not None:
-            from gofr_tpu.hbmz import hbm_occupancy
-            hbm = hbm_occupancy(self.container)
         return {"queue_depth": queue_depth, "occupancy": occupancy,
-                "hbm": hbm, "decode_replicas": decode}
+                "hbm": hbm, "decode_replicas": decode,
+                "source": "probe"}
 
     def _pick_victim(self) -> Optional[str]:
         """Least-loaded READY decode replica (the cheapest to drain by

@@ -276,16 +276,21 @@ class _Fetch:
     engine-step span (dispatch → publish), finished when the fetch
     lands. ``dispatched_at`` anchors device-time attribution: dispatch →
     publish wall time is charged to the participating requests' {model,
-    slo class} (ISSUE 10)."""
-    __slots__ = ("task", "kind", "payload", "span", "dispatched_at")
+    slo class} (ISSUE 10). ``anatomy`` is the sampled decode-tick phase
+    breakdown (ISSUE 16): None on unsampled ticks; on every Nth tick the
+    loop stashes host-side phase timings here and ``_publish`` completes
+    them with the device wait before handing the dict to telemetry."""
+    __slots__ = ("task", "kind", "payload", "span", "dispatched_at",
+                 "anatomy")
 
     def __init__(self, task, kind: str, payload,
-                 span: Optional[Span] = None):
+                 span: Optional[Span] = None, anatomy=None):
         self.task = task
         self.kind = kind
         self.payload = payload
         self.span = span
         self.dispatched_at = time.monotonic()
+        self.anatomy = anatomy
 
 
 class GenerationEngine:
@@ -646,6 +651,13 @@ class GenerationEngine:
         self._adopt_dedup_hits = 0
         self._brownout = 0
         self._quarantined: Dict[str, int] = {}
+        # continuous telemetry plane (ISSUE 16): when a TimeSeriesStore is
+        # attached, every Nth decode tick carries a phase-anatomy dict.
+        # Unsampled ticks pay one attribute load plus a modulo — nothing
+        # else changes on the hot path when telemetry is off (None).
+        self.telemetry = None
+        self._tick_seq = 0
+        self._tick_every = 64
 
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._insert_fns: Dict[Tuple[int, int], Any] = {}
@@ -2373,6 +2385,14 @@ class GenerationEngine:
             return None
         return self._pool.free_pages - self._kv_reserve
 
+    def attach_telemetry(self, store, every: int = 64) -> None:
+        """Wire the continuous telemetry plane (ISSUE 16): ``store`` gets
+        a phase-anatomy dict for every ``every``-th decode tick via
+        ``note_tick``. Called by the app when telemetry is enabled; never
+        called → zero-cost (``self.telemetry`` stays None)."""
+        self.telemetry = store
+        self._tick_every = max(1, int(every))
+
     def stats(self) -> Dict[str, Any]:
         out = {"model": self.model_name,
                "active_slots": self.active_slots,
@@ -2820,6 +2840,13 @@ class GenerationEngine:
 
     async def _loop_body(self, loop) -> None:
         q = self._publishq
+        # sampled decode-tick anatomy (ISSUE 16): decide up front whether
+        # the NEXT dispatched tick is the Nth — only then do the phase
+        # clocks run. Unsampled passes cost one attr load plus a modulo.
+        ts = self.telemetry
+        sampled = (ts is not None
+                   and (self._tick_seq + 1) % self._tick_every == 0)
+        t_admit = time.monotonic() if sampled else 0.0
         # 1. batched admission of everything pending (up to free slots);
         #    each prefill's first-token fetch starts concurrently
         for first_dev, claimed, step_span in await self._admit_pending(loop):
@@ -2832,12 +2859,23 @@ class GenerationEngine:
         dispatched = False
         if (self.active_slots > 0
                 and self._ticks_inflight < self.max_inflight_ticks):
+            t_dispatch = time.monotonic() if sampled else 0.0
             tick = await self._dispatch_tick(loop)
             if tick is not None:
                 kind, fetch, payload, step_span = tick
                 self._ticks_inflight += 1
+                anatomy = None
+                if ts is not None:
+                    self._tick_seq += 1
+                    if sampled:
+                        done = time.monotonic()
+                        anatomy = {
+                            "admission_s": t_dispatch - t_admit,
+                            "host_dispatch_s": done - t_dispatch,
+                        }
                 q.append(_Fetch(loop.run_in_executor(None, fetch),
-                                kind, payload, span=step_span))
+                                kind, payload, span=step_span,
+                                anatomy=anatomy))
                 dispatched = True
 
         if not q:
@@ -2895,6 +2933,20 @@ class GenerationEngine:
 
     def _publish(self, entry: _Fetch, host) -> None:
         self._attribute_device_time(entry)
+        # sampled tick anatomy (ISSUE 16): the dispatch phases were
+        # clocked in _loop_body; the device wait (dispatch → fetch landed)
+        # completes the breakdown before it enters the flight-recorder
+        # ring. Unsampled entries carry anatomy=None — one pointer test.
+        if entry.anatomy is not None and self.telemetry is not None:
+            anatomy = entry.anatomy
+            anatomy["device_wait_s"] = time.monotonic() - entry.dispatched_at
+            anatomy["kind"] = entry.kind
+            anatomy["batch"] = len(entry.payload[0]
+                                   if entry.kind == "spec"
+                                   else entry.payload)
+            anatomy["step"] = self._steps
+            anatomy["at"] = time.time()
+            self.telemetry.note_tick(anatomy)
         if entry.kind == "prefill":
             for slot_idx, gen, row in entry.payload:
                 self._push_tokens(slot_idx, gen, [int(host[row])])

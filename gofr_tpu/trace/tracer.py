@@ -48,7 +48,12 @@ class Span:
     """
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
-                 "attributes", "links", "_tracer", "_token", "status")
+                 "attributes", "links", "events", "_tracer", "_token",
+                 "status")
+
+    # span events are bounded so a chaos storm (one event per injected
+    # fault) can never grow a span without limit
+    MAX_EVENTS = 64
 
     def __init__(self, tracer: Optional["Tracer"], name: str,
                  trace_id: Optional[str] = None, parent_id: Optional[str] = None):
@@ -60,6 +65,7 @@ class Span:
         self.end: Optional[float] = None
         self.attributes: Dict[str, str] = {}
         self.links: List[Dict[str, str]] = []
+        self.events: List[Dict[str, object]] = []
         self.status: str = "OK"
         self._tracer = tracer
         self._token: Optional[contextvars.Token] = None
@@ -70,8 +76,25 @@ class Span:
     def add_link(self, other: "Span") -> None:
         """Link another span (many-to-one causality, e.g. one batched engine
         step serving several requests — OTel span-links analog)."""
-        self.links.append({"trace_id": other.trace_id,
-                           "span_id": other.span_id})
+        if len(self.links) < self.MAX_EVENTS:
+            self.links.append({"trace_id": other.trace_id,
+                               "span_id": other.span_id})
+
+    def add_event(self, name: str, **attrs) -> None:
+        """Timestamped point annotation inside the span (OTel span-events
+        analog) — why a phase stalled, not just that it did. The chaos
+        plane stamps fault injections here, the brownout ladder its
+        level transitions; past ``MAX_EVENTS`` further events drop
+        silently rather than growing the span."""
+        if len(self.events) < self.MAX_EVENTS:
+            self.events.append({
+                "name": str(name),
+                "t": time.time(),
+                "attributes": {str(k): str(v) for k, v in attrs.items()},
+            })
+
+    def find_events(self, name: str) -> List[Dict[str, object]]:
+        return [e for e in self.events if e["name"] == name]
 
     def set_status(self, status: str) -> None:
         self.status = status
@@ -169,6 +192,14 @@ class _ZipkinExporter(_Exporter):
                 "timestamp": int(span.start * 1e6),
                 "duration": int(((span.end or span.start) - span.start) * 1e6),
                 "localEndpoint": {"serviceName": self.service_name},
+                # span events map onto Zipkin v2's first-class
+                # annotations (timestamped point values)
+                "annotations": [
+                    {"timestamp": int(e["t"] * 1e6),
+                     "value": "%s %s" % (e["name"], e["attributes"])
+                     if e["attributes"] else e["name"]}
+                    for e in span.events
+                ],
                 # Zipkin v2 has no first-class span links; encode them as a
                 # tag so the linked trace ids survive into the UI
                 "tags": dict(

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Tier-1 telemetry smoke: a seeded goodput cliff must be *seen*.
+
+A tiny engine (forced host devices) serves real traffic while a
+``TimeSeriesStore`` samples a delivered-tokens counter on a synthetic
+1 Hz clock and records sampled decode-tick anatomy. After a healthy
+baseline, a seeded ``nan_logits`` fault plan poisons every request —
+each one quarantines, delivered tokens flatline, and the smoke asserts
+the full detection path the telemetry plane exists for:
+
+1. the change-point detector raises a ``down`` anomaly on the delivered
+   rate within one trigger window of the cliff,
+2. the watchdog reason names the offending signal (this is the string
+   that flips the replica DEGRADED in statusz),
+3. sampled tick anatomy recorded real phase timings at the configured
+   cadence, and
+4. the store's memory stays inside its documented bucket bound and a
+   cursor delta pull returns the sampled history.
+
+Prints ``telemetry smoke: OK`` and exits 0, or raises with the failing
+property. Budget: a few seconds on 8 host CPU devices.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.metrics.timeseries import (MAX_BUCKETS_PER_SIGNAL,
+                                             TimeSeriesStore)
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu import faults
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    container = new_mock_container()
+    engine = GenerationEngine(cfg, params, max_slots=2, max_len=32,
+                              prompt_buckets=(8,), kv_page=4,
+                              paged_kv=True, prefix_cache=False,
+                              logger=container.logger,
+                              metrics=container.metrics)
+
+    # short detector window so the smoke stays in seconds: 12 eligible
+    # baseline buckets (past the 5-bucket guard), 3-sample trigger
+    store = TimeSeriesStore(metrics=container.metrics,
+                            detector_min_baseline=12,
+                            detector_trigger_after=3,
+                            tick_sample=2)
+    delivered = {"n": 0}
+    store.register("delivered_tok_s", lambda: float(delivered["n"]),
+                   kind="counter", watch="down")
+
+    prompt, budget = [9, 8, 7], 4
+
+    async def run() -> None:
+        engine.attach_telemetry(store, every=store.tick_sample)
+        await engine.start()
+        try:
+            t = 0.0
+            store.sample(now=t)          # counter priming sample
+            # healthy baseline: one request per synthetic second
+            for _ in range(20):
+                tokens = await asyncio.wait_for(engine.generate(
+                    prompt, max_new_tokens=budget), 60.0)
+                delivered["n"] += len(tokens)
+                t += 1.0
+                store.sample(now=t)
+            assert store.watchdog_reasons() == [], \
+                "healthy baseline raised an anomaly"
+
+            # the cliff: every request hits seeded NaN logits and
+            # quarantines — delivered tokens flatline at the same cadence
+            plan = faults.FaultPlan("nan_logits", seed=11)
+            faults.install(plan)
+            raised = None
+            for _ in range(6):
+                try:
+                    tokens = await asyncio.wait_for(engine.generate(
+                        prompt, max_new_tokens=budget), 60.0)
+                    delivered["n"] += len(tokens)
+                except Exception:
+                    pass                  # the poison path: zero delivered
+                t += 1.0
+                store.sample(now=t)
+                raised = store.anomalies()["active"].get("delivered_tok_s")
+                if raised is not None:
+                    break
+            assert plan.fired("nan_logits") >= 1, \
+                "the armed fault never fired — the smoke proved nothing"
+            assert raised is not None and raised["direction"] == "down", \
+                f"goodput cliff went undetected: {store.anomalies()}"
+            reasons = store.watchdog_reasons()
+            assert any("delivered_tok_s down" in r for r in reasons), \
+                f"watchdog reason does not name the signal: {reasons}"
+
+            anatomy = store.tick_anatomy()
+            assert anatomy["recorded"] >= 1, "no tick anatomy sampled"
+            assert anatomy["phases"]["device_wait_s"]["mean_s"] > 0.0
+            info = store.memory_info()
+            assert info["buckets_held"] <= MAX_BUCKETS_PER_SIGNAL, info
+            delta = store.delta(None)
+            assert delta["samples"], "cursor delta returned no history"
+
+            # the timez page serves the same history as aligned series
+            from types import SimpleNamespace
+
+            from gofr_tpu.timez import build_timez
+            app = SimpleNamespace(container=SimpleNamespace(
+                app_name="smoke", app_version="0", telemetry=store))
+            page = build_timez(app, tier="1s")
+            series = page["series"]
+            assert series["t"], "timez served an empty time axis"
+            assert len(series["series"]["delivered_tok_s"]) == \
+                len(series["t"]), "timez series misaligned with axis"
+        finally:
+            faults.reset()
+            await engine.stop()
+
+    asyncio.run(run())
+    print("telemetry smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

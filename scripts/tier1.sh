@@ -33,6 +33,10 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || exit 1
 # fleet — stream completes token-identical (exactly-once indices),
 # one ok resume, every page pool back at its free-list baseline
 timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
+# telemetry smoke: seeded nan_logits goodput cliff on live traffic —
+# change-point detector raises "down" within one trigger window, the
+# watchdog reason names the signal, tick anatomy sampled, memory bounded
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py || exit 1
 # ragged paged attention smoke: greedy token identity dense vs gather vs
 # the fused Pallas kernel (interpret mode), width-ladder retirement in
 # the ledger, sentinel pages never dereferenced (NaN poisoning)

@@ -1,0 +1,25 @@
+"""GT011 negative fixture: every recording buffer carries a bound."""
+
+from collections import deque
+
+MAX_EVENTS = 64
+
+
+class BoundedRecorder:
+    def __init__(self):
+        self.samples = deque(maxlen=256)   # ring: bounded by construction
+        self.events = []                   # bounded by the len() gate
+        self.recent = []                   # bounded by the del-slice trim
+        self.by_name = {}                  # bounded by the pop below
+
+    def record(self, value):
+        self.samples.append(value)
+        if len(self.events) < MAX_EVENTS:
+            self.events.append(value)
+        self.recent.append(value)
+        del self.recent[:-32]
+
+    def observe(self, name, value):
+        self.by_name[name] = value
+        while len(self.by_name) > MAX_EVENTS:
+            self.by_name.pop(next(iter(self.by_name)))

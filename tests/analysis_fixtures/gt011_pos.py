@@ -1,0 +1,39 @@
+"""GT011 positive fixture: telemetry buffers that only ever grow."""
+
+TICKS = []
+
+
+def on_tick(entry):
+    # module-level list grown per tick, never trimmed
+    TICKS.append(entry)
+
+
+class Recorder:
+    def __init__(self):
+        self.samples = []
+        self.by_name = {}
+        self.latest = None
+
+    def record(self, value):
+        # per-sample append with no bound in the whole module
+        self.samples.append(value)
+        self.latest = value
+
+    def observe(self, name, value):
+        # dict grows one key per observed name forever
+        self.by_name[name] = value
+
+    def build_schema(self):
+        # not a recording hot path: one-shot setup may build structure
+        self.schema = []
+        self.schema.append("t")
+        return self.schema
+
+
+class Forensics:
+    def __init__(self):
+        self.crashes = []
+
+    def note_crash(self, entry):
+        # deliberate: crash forensics keep everything until process end
+        self.crashes.append(entry)  # graftcheck: ignore[GT011]

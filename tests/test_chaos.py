@@ -648,3 +648,60 @@ def test_resume_budget_exhausts_and_surfaces_the_fault(setup):
                 await engine.stop()
 
     asyncio.run(run())
+
+
+# -- chaos-plane trace visibility (ISSUE 16) ----------------------------------
+
+def test_crash_resume_trace_carries_fault_injection_event(setup):
+    """A crash_mid_decode that heals via resume is invisible in the
+    token stream by design — the trace is where it must show: the
+    injection stamps a ``fault.injected`` event (site + arrival) on the
+    span surrounding the stream."""
+    cfg, params = setup
+    from gofr_tpu.trace.tracer import Tracer
+
+    async def run():
+        engines = {}
+        cluster = ClusterRegistry()
+        for name in ("d0", "d1"):
+            engine, _ = _make_engine(cfg, params)
+            engines[name] = engine
+            cluster.register(name, ROLE_BOTH, InProcTransport(engine))
+        router = FleetRouter(cluster)
+        for engine in engines.values():
+            await engine.start()
+        tracer = Tracer("chaos-test")
+        faults.install(faults.FaultPlan("crash_mid_decode:@2", seed=3))
+        try:
+            with tracer.start_span("fleet.generate") as span:
+                session = await router.generate_stream(
+                    [9, 8, 7], max_new_tokens=6)
+                tokens = [t async for t in session]
+            assert len(tokens) == 6            # the stream healed...
+            events = span.find_events("fault.injected")
+            assert len(events) == 1            # ...and the trace tells why
+            assert events[0]["attributes"] == {"site": "crash_mid_decode",
+                                               "arrival": "2"}
+            assert router.fleet_stats()["resumes"]["ok"] == 1
+        finally:
+            faults.reset()
+            for engine in engines.values():
+                await engine.stop()
+
+    asyncio.run(run())
+
+
+def test_brownout_transitions_stamp_level_events_on_active_span():
+    from gofr_tpu.trace.tracer import Tracer
+
+    ladder = BrownoutLadder(escalate_after=1, recover_after=1)
+    tracer = Tracer("chaos-test")
+    with tracer.start_span("watchdog.evaluate") as span:
+        ladder.observe(True)       # 0 -> 1
+        ladder.observe(True)       # 1 -> 2
+        ladder.observe(False)      # 2 -> 1
+    moves = [(e["attributes"]["previous"], e["attributes"]["level"])
+             for e in span.find_events("brownout.level")]
+    assert moves == [("0", "1"), ("1", "2"), ("2", "1")]
+    assert all(e["attributes"]["role"] == "both"
+               for e in span.find_events("brownout.level"))

@@ -400,7 +400,7 @@ def test_cli_list_rules_covers_catalog():
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
         {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007",
-         "GT008", "GT009", "GT010"}
+         "GT008", "GT009", "GT010", "GT011"}
 
 
 def test_lint_metrics_shim_still_works():
@@ -420,3 +420,34 @@ def test_lint_metrics_shim_docs_drift(tmp_path):
         cwd=REPO, capture_output=True, text=True)
     assert proc.returncode == 1
     assert "missing from the metrics catalog" in proc.stderr
+
+
+# -- GT011 unbounded telemetry buffer -----------------------------------------
+
+def test_gt011_positive_flags_growing_recorder_buffers():
+    report = scan("gt011_pos.py", "GT011", scope_all=True)
+    got = keys(report)
+    assert "unbounded telemetry buffer 'TICKS'" in got      # module-level
+    assert "unbounded telemetry buffer 'samples'" in got    # self.X append
+    assert "unbounded telemetry buffer 'by_name'" in got    # dict subscript
+    # one-shot setup (build_schema) may build structure: not flagged
+    assert "unbounded telemetry buffer 'schema'" not in got
+    assert all(f.rule == "GT011" and f.severity == "error"
+               for f in report.new_findings)
+    # the pragma'd crash-forensics buffer is suppressed, not reported
+    assert "unbounded telemetry buffer 'crashes'" not in got
+    assert report.suppressed >= 1
+
+
+def test_gt011_negative_bounded_shapes_are_clean():
+    report = scan("gt011_neg.py", "GT011", scope_all=True)
+    assert report.new_findings == []
+    assert report.exit_code == 0
+
+
+def test_gt011_scoping_skips_non_telemetry_modules_by_default():
+    # without scope_all the fixture path (tests/analysis_fixtures/...)
+    # is out of scope: the rule only patrols metrics/trace packages and
+    # telemetry-named modules
+    report = scan("gt011_pos.py", "GT011")
+    assert report.new_findings == []
