@@ -181,6 +181,18 @@ REGRESSION_NOTES = {
         "network or failure-detection latency priced; compare against "
         "max_gap_ms_control from the SAME run, swings with host load "
         "on the CPU bench container"),
+    "llama_replay_deterministic": (
+        "new in r15 (workload capture & replay plane): 1 iff two "
+        "replays of the same recorded trace produced identical "
+        "admitted-token counts, per-class tallies, and digests — the "
+        "property that makes a trace a usable A/B harness; asserted "
+        "in-artifact, any value but 1 fails the round"),
+    "llama_replay_attribution_gap_pct": (
+        "new in r15: |per-executable-family ledger total - per-class "
+        "aggregate device-seconds| as % of the aggregate on the capture "
+        "arm — both planes charge from one shared dispatch-site helper, "
+        "so the bar is <= 10% (asserted in-artifact); a jump means a "
+        "dispatch site charges one plane and not the other"),
     "llama_batch_lane_tok_s_soaked": (
         "new in r11 (async batch lane): batch tokens the pub/sub lane "
         "completed during the interactive window / that window's wall "
@@ -244,6 +256,9 @@ _LEDGER_PATHS = {
     "llama_chaos_goodput_ratio": ("llama_chaos", "goodput_ratio"),
     "llama_chaos_resume_downtime_ms": ("llama_chaos",
                                        "resume_downtime_ms"),
+    "llama_replay_deterministic": ("llama_replay", "deterministic"),
+    "llama_replay_attribution_gap_pct": ("llama_replay",
+                                         "attribution_gap_pct"),
     "llama_batch_lane_tok_s_soaked": ("llama_batch_lane",
                                       "batch_tok_s_soaked"),
     "llama_batch_lane_interactive_ratio": ("llama_batch_lane",
@@ -328,6 +343,7 @@ def main() -> None:
     llama_disagg = _llama_disagg_bench(on_tpu)
     llama_fleet = _llama_fleet_bench(on_tpu)
     llama_chaos = _llama_chaos_bench(on_tpu)
+    llama_replay = _llama_replay_bench(on_tpu)
     multi_model = _multi_model_bench(on_tpu)
     llama_batch_lane = _llama_batch_lane_bench(on_tpu)
     llama7b = _llama7b_int8_bench(on_tpu)
@@ -353,6 +369,7 @@ def main() -> None:
         "llama_disagg": llama_disagg,
         "llama_fleet": llama_fleet,
         "llama_chaos": llama_chaos,
+        "llama_replay": llama_replay,
         "multi_model": multi_model,
         "llama_batch_lane": llama_batch_lane,
         "llama7b_int8": llama7b,
@@ -1994,6 +2011,143 @@ def _llama_chaos_bench(on_tpu: bool):
                  "exactly_once=false means exact-logit-tie argmax "
                  "flips at the re-prefill, not lost or duplicated "
                  "tokens"),
+    }
+
+
+def _llama_replay_bench(on_tpu: bool):
+    """Workload capture & replay plane (ISSUE 17, docs/quick-start/
+    observability.md "Workload capture & replay"): record a live
+    class-mixed workload shape-only, export the versioned trace, then
+    replay it twice through fresh engines on the virtual clock. Priced:
+
+    - ``deterministic`` — 1 iff both replays produced identical
+      admitted-token counts, per-class outcome tallies, and digests.
+      This is the ISSUE 17 acceptance bar and the property that makes
+      a recorded trace a usable A/B harness for knob changes; asserted
+      in-artifact, a 0 here fails the round.
+    - ``attribution_gap_pct`` — |per-family executable-ledger total −
+      per-class aggregate device-seconds| as a percentage of the
+      aggregate, from the capture arm's engine. Both planes charge from
+      the same dispatch-site helper, so the acceptance bar is <= 10%
+      (asserted in-artifact).
+    - ``replay_tok_s`` — delivered tok/s of the first replay arm, the
+      throughput of the replay harness itself (compare within a round,
+      it rides host load like every CPU-bench number)."""
+    import time
+
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.slo import set_request_deadline
+    from gofr_tpu.tpu.generate import GenerationEngine
+    from gofr_tpu.tpu.workload import (TrafficRecorder, load_trace,
+                                       replay_trace)
+
+    if on_tpu:
+        preset, max_len, buckets, page, slots = (
+            "small", 512, (64, 128), 32, 8)
+        prompt_len = 24
+    else:
+        preset, max_len, buckets, page, slots = "tiny", 64, (8, 16), 4, 4
+        prompt_len = 6
+    cfg = llama.config(preset)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    n_requests, budget = 8, 6
+    # class mix via deadline budgets: <=2s → interactive, larger →
+    # standard, None → batch (sched.deadline_class)
+    budgets_ms = [1500, None, 30000, 1500, None, 30000, 1500, None]
+    prompts = [[(7 * i + 3 * j) % 250 + 1 for j in range(prompt_len)]
+               for i in range(n_requests)]
+
+    def build():
+        container = new_mock_container()
+        return GenerationEngine(
+            cfg, params, max_slots=slots, max_len=max_len,
+            prompt_buckets=buckets, kv_page=page, paged_kv=True,
+            steps_per_tick=4,
+            logger=container.logger, metrics=container.metrics)
+
+    # -- capture arm: live traffic through an instrumented engine -----
+    recorder = TrafficRecorder(capacity=256)
+    capture_engine = build()
+
+    async def capture():
+        await capture_engine.start()
+        try:
+            async def req(prompt, budget_ms):
+                set_request_deadline(budget_ms)
+                return await capture_engine.generate(
+                    prompt, max_new_tokens=budget)
+            # warm the compile ladder deadline-free BEFORE attaching the
+            # recorder: first-round compiles dwarf any interactive
+            # budget, and the recorded trace should price the workload,
+            # not the cold start
+            await asyncio.gather(*[req(p, None) for p in prompts])
+            capture_engine.attach_workload(recorder)
+            await asyncio.gather(*[
+                req(p, b) for p, b in zip(prompts, budgets_ms)])
+        finally:
+            await capture_engine.stop()
+
+    asyncio.run(capture())
+    snap = recorder.snapshot()
+    # per-family ledger vs per-class aggregate: one charge site, so the
+    # totals must agree (ISSUE 17 acceptance: within 10%)
+    agg = sum(capture_engine._device_seconds.values())
+    fam = capture_engine.exec_ledger.total_seconds(
+        capture_engine.model_name)
+    gap_pct = round(abs(fam - agg) / agg * 100.0, 3) if agg else None
+    assert gap_pct is not None and gap_pct <= 10.0, (fam, agg)
+    exec_top = capture_engine.xlaz()["executables"]["top"]
+
+    trace = load_trace(recorder.export_trace())
+
+    # -- replay ×2 on fresh engines: determinism is the acceptance bar
+    async def replay_once():
+        engine = build()
+        await engine.start()
+        try:
+            start = time.perf_counter()
+            result = await replay_trace(engine, trace, time_scale=1.0)
+            result["_elapsed_s"] = time.perf_counter() - start
+            return result
+        finally:
+            await engine.stop()
+
+    first = asyncio.run(replay_once())
+    second = asyncio.run(replay_once())
+    elapsed = first.pop("_elapsed_s")
+    second.pop("_elapsed_s")
+    deterministic = int(first == second)
+    assert deterministic, (first, second)
+    assert first["errors"] == 0, first
+
+    return {
+        "preset": preset,
+        "requests": n_requests,
+        "recorded": {
+            "class_mix": snap["class_mix"],
+            "finish_mix": snap["finish_mix"],
+            "mean_interarrival_s": snap["interarrival_s"]["mean"],
+        },
+        "replay_tok_s": (round(first["admitted_tokens"] / elapsed, 1)
+                         if elapsed else None),
+        "admitted_tokens": first["admitted_tokens"],
+        "per_class": {cls: entry["tokens"]
+                      for cls, entry in first["per_class"].items()},
+        "digest": first["digest"],
+        # acceptance: two replays bit-identical, attribution planes agree
+        "deterministic": deterministic,
+        "attribution_gap_pct": gap_pct,
+        "executable_families": [
+            {"family": row["family"], "share": row["share"]}
+            for row in exec_top[:4]],
+        "note": ("capture arm records shape only (lengths/classes/"
+                 "inter-arrivals); replays synthesize prompts of the "
+                 "recorded lengths with per-index seeds and decode with "
+                 "eos_id=None, so admitted tokens are pinned by the "
+                 "trace — compare replay_tok_s within a round only"),
     }
 
 

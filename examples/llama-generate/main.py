@@ -234,6 +234,7 @@ def build_app():
     app.enable_xlaz()           # compile ledger + prompt-bucket fit view
     app.enable_hbmz()           # device-memory attribution + watchdog HBM
     app.enable_timez()          # multi-res series + anomalies + tick anatomy
+    app.enable_workloadz()      # traffic-shape ring + trace export + roofline
     app.enable_profiler()       # duration-capped on-demand XLA captures
 
     @app.on_startup

@@ -18,6 +18,7 @@ from gofr_tpu.analysis.rules.gt009_cron import CronReentrancyRule
 from gofr_tpu.analysis.rules.gt010_retry import UnboundedRetryRule
 from gofr_tpu.analysis.rules.gt011_telemetry import \
     UnboundedTelemetryBufferRule
+from gofr_tpu.analysis.rules.gt012_workload import WorkloadContentLeakRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -31,6 +32,7 @@ ALL_RULES = (
     CronReentrancyRule,
     UnboundedRetryRule,
     UnboundedTelemetryBufferRule,
+    WorkloadContentLeakRule,
 )
 
 
@@ -38,7 +40,7 @@ def default_rules(select: Optional[Sequence[str]] = None,
                   **options) -> List[Rule]:
     """Instantiate the rule set, optionally filtered to ``select`` ids.
     ``options`` are forwarded to rules that accept them (GT005 takes
-    ``docs_catalog``, GT011 takes ``scope_all``)."""
+    ``docs_catalog``, GT011/GT012 take ``scope_all``)."""
     rules: List[Rule] = []
     for cls in ALL_RULES:
         if select and cls.rule_id not in select:
@@ -46,6 +48,8 @@ def default_rules(select: Optional[Sequence[str]] = None,
         if cls is MetricDisciplineRule and "docs_catalog" in options:
             rules.append(cls(docs_catalog=options["docs_catalog"]))
         elif cls is UnboundedTelemetryBufferRule and "scope_all" in options:
+            rules.append(cls(scope_all=options["scope_all"]))
+        elif cls is WorkloadContentLeakRule and "scope_all" in options:
             rules.append(cls(scope_all=options["scope_all"]))
         else:
             rules.append(cls())

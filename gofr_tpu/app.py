@@ -261,6 +261,11 @@ class App:
         from gofr_tpu.timez import enable_timez
         enable_timez(self, prefix)
 
+    # -- workload capture workloadz (no reference analog; workloadz.py) -----
+    def enable_workloadz(self, prefix: str = "/debug/workloadz") -> None:
+        from gofr_tpu.workloadz import enable_workloadz
+        enable_workloadz(self, prefix)
+
     # -- external DB injection (externalDB.go:5-39) -------------------------
     def add_mongo(self, client=None) -> None:
         if client is None:
@@ -406,6 +411,19 @@ class App:
             if asyncio.iscoroutine(result):
                 await result
 
+        # workload capture plane (ISSUE 17): bounded shape-only traffic
+        # recorder (TRAFFIC_REC_ENABLED, default on) feeding
+        # /debug/workloadz and the bench.py replay harness. Built before
+        # the batcher so the enqueue hook can ride its constructor; the
+        # engine admission hook attaches via attach_workload.
+        from gofr_tpu.tpu.workload import new_traffic_recorder
+        self.container.workload = new_traffic_recorder(
+            self.config, metrics=self.container.metrics)
+        if (self.container.workload is not None
+                and self.container.tpu is not None
+                and hasattr(self.container.tpu, "attach_workload")):
+            self.container.tpu.attach_workload(self.container.workload)
+
         # dynamic batcher on the serving loop (north star: coalesce
         # concurrent requests into one XLA execute)
         if self.container.tpu is not None:
@@ -415,7 +433,8 @@ class App:
                 max_batch=self.config.get_int("TPU_MAX_BATCH", 32),
                 max_delay_ms=self.config.get_float("TPU_BATCH_DELAY_MS", 2.0),
                 logger=self.logger, tracer=self.container.tracer,
-                slo=self.container.slo, metrics=self.container.metrics)
+                slo=self.container.slo, metrics=self.container.metrics,
+                workload=self.container.workload)
 
         # chaos plane (ISSUE 14): FAULT_PLAN installs a seeded
         # fault-injection plan over the serving layers' named sites.

@@ -63,6 +63,10 @@ class Container:
         # store + anomaly detector, created by App.start (TELEMETRY_*);
         # /debug/timez and the statusz sparkline section read it here
         self.telemetry = None
+        # workload capture plane (ISSUE 17): the bounded shape-only
+        # TrafficRecorder, created by App.start (TRAFFIC_REC_*);
+        # /debug/workloadz and the replay harness read it here
+        self.workload = None
 
         self._start_time = time.time()
 
@@ -417,6 +421,21 @@ class Container:
             "mid-stream decode resumes by result (ok|no_ctx|budget|"
             "exhausted|no_replica|error) — ok means the stream was "
             "rebuilt from prompt + emitted tokens on a live replica")
+        # workload capture & roofline attribution (ISSUE 17): the
+        # shape-only traffic recorder's admission pulse, and the
+        # per-executable-family twin of app_tpu_device_seconds_total —
+        # same elapsed windows, keyed by compiled executable instead of
+        # SLO class, so the two totals agree by construction
+        metrics.new_counter(
+            "app_tpu_workload_events_total",
+            "requests admitted into the workload recorder's shape-only "
+            "ring, per (model, SLO class) — token lengths and timings "
+            "only, never token content")
+        metrics.new_updown_counter(
+            "app_tpu_executable_device_seconds_total",
+            "dispatch→publish device step wall time per (model, "
+            "compiled executable family) — the roofline-attribution "
+            "twin of app_tpu_device_seconds_total; their totals match")
         metrics.new_updown_counter("app_http_inflight",
                                    "inbound HTTP requests currently in flight")
         metrics.new_histogram("app_cron_duration", "cron job run time (s)",

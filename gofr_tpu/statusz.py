@@ -104,6 +104,16 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
                 status["data_plane"] = data_plane_fn()
             except Exception as exc:
                 status["data_plane"] = {"error": repr(exc)}
+        # per-executable roofline attribution (ISSUE 17): ranked
+        # top-offenders by device-seconds — which compiled executable
+        # family is burning the device, and how far from roofline; the
+        # full table lives on /debug/xlaz and /debug/workloadz
+        exec_ledger = getattr(tpu, "exec_ledger", None)
+        if exec_ledger is not None:
+            try:
+                status["executables"] = exec_ledger.snapshot(limit=8)
+            except Exception as exc:
+                status["executables"] = {"error": repr(exc)}
         # compile-plane summary (ISSUE 3): totals + the serve-time-compile
         # window the watchdog acts on; the full table lives on /debug/xlaz
         ledger = getattr(tpu, "ledger", None)

@@ -50,7 +50,7 @@ class _Pending:
 class DynamicBatcher:
     def __init__(self, executor, max_batch: int = 32,
                  max_delay_ms: float = 2.0, logger=None, tracer=None,
-                 slo=None, metrics=None):
+                 slo=None, metrics=None, workload=None):
         self.executor = executor
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1000.0
@@ -58,6 +58,9 @@ class DynamicBatcher:
         self.tracer = tracer
         self.slo = slo  # SLOTracker (goodput/outcome accounting), optional
         self.metrics = metrics
+        # workload capture (ISSUE 17): arrival pulse per enqueue (model
+        # mix + inter-arrival shape only); None → zero-cost
+        self.workload = workload
         self._pending: Dict[str, _Pending] = {}
         # flush-cause accounting (ISSUE 3): "full" flushes mean the ladder/
         # max_batch is the binding constraint, "timer" flushes mean traffic
@@ -78,6 +81,8 @@ class DynamicBatcher:
         pending.examples.append(example)
         pending.futures.append(future)
         pending.spans.append(span)
+        if self.workload is not None:
+            self.workload.note_enqueue(name)
         # the request's deadline rides with the example: checked again at
         # flush time, after queue wait has eaten part of the budget
         pending.deadlines.append(current_deadline())

@@ -25,6 +25,16 @@ Three pieces, all host-side and bounded:
   padding-optimal bucket ladder of a given rung count (exact dynamic
   program over observed sizes). ``/debug/xlaz`` serves it so operators
   can close the tuning loop: observe → resize ladder → re-warm.
+- :class:`ExecutableLedger` + :func:`charge_device_time` — the
+  per-executable-family device-time join (ISSUE 17): the compile plane
+  above says *which* executables exist; this says which of them are
+  burning the device-seconds and how far from roofline each sits
+  (achieved FLOP/s from cached ``cost_analysis`` vs ``TPU_PEAK_FLOPS``).
+  ``charge_device_time`` is THE shared dispatch-site timing helper —
+  one measured elapsed charges both the ``{model, cls}`` aggregate
+  (``app_tpu_device_seconds_total``) and the ``{model, family}``
+  executable row, so the two totals agree by construction instead of by
+  two clocks drifting apart.
 """
 
 from __future__ import annotations
@@ -143,6 +153,132 @@ class CompileLedger:
             "serving_compiles_60s": self.serving_compiles(60.0, now),
             "recent": events,
         }
+
+
+class ExecutableLedger:
+    """Device-seconds per compiled executable *family* — the answer to
+    "which executable is burning the device time, and how far from
+    roofline is it?". A family is the stable human-readable key of one
+    compiled program shape (``decode_paged[k=8,pw=16]``,
+    ``prefill[nb=4,b=64]``, executor ``b32`` buckets); rows accumulate
+    device-seconds, dispatch counts, and (when the caller knows them)
+    executed FLOPs, from which the snapshot derives achieved FLOP/s and
+    the achieved-vs-roofline ratio against ``peak_flops``.
+
+    Bounded: the family set is closed by the compile ladders, but a
+    misbehaving caller cannot grow it past ``max_families`` — excess
+    charges are counted in ``dropped_families`` rather than stored.
+    Thread-safe (executor fetches run on worker threads)."""
+
+    def __init__(self, metrics: Any = None, peak_flops: float = 0.0,
+                 max_families: int = 256):
+        self.metrics = metrics
+        self.peak_flops = float(peak_flops)
+        self._max_families = int(max_families)
+        self._lock = threading.Lock()
+        # (model, family) -> [device_seconds, dispatches, flops]
+        self._rows: Dict[Tuple[str, str], List[float]] = {}
+        self._dropped = 0
+
+    def charge(self, model: str, family: str, seconds: float,
+               flops: Optional[float] = None) -> None:
+        """One dispatch→publish measurement for ``family``. ``flops`` is
+        the executed FLOPs of that dispatch when the caller has a cached
+        ``cost_analysis`` (executor buckets); engines whose executables
+        ride ``jax.jit`` caches pass None and their rows report a null
+        roofline ratio rather than a guessed one."""
+        if seconds <= 0:
+            return
+        key = (model, family)
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                if len(self._rows) >= self._max_families:
+                    self._dropped += 1
+                    return
+                row = self._rows[key] = [0.0, 0, 0.0]
+            row[0] += seconds
+            row[1] += 1
+            if flops:
+                row[2] += flops
+        if self.metrics is not None:
+            self.metrics.delta_updown_counter(
+                "app_tpu_executable_device_seconds_total", seconds,
+                model=model, family=family)
+
+    def total_seconds(self, model: Optional[str] = None) -> float:
+        with self._lock:
+            return sum(row[0] for (m, _), row in self._rows.items()
+                       if model is None or m == model)
+
+    def snapshot(self, limit: int = 12) -> Dict[str, Any]:
+        """Ranked top-offenders table (xlaz/statusz/workloadz): families
+        by device-seconds descending, each with its share of the total,
+        dispatch count, and roofline position when FLOPs are known."""
+        with self._lock:
+            rows = [(m, f, row[0], row[1], row[2])
+                    for (m, f), row in self._rows.items()]
+            dropped = self._dropped
+        total = sum(seconds for _, _, seconds, _, _ in rows)
+        rows.sort(key=lambda r: r[2], reverse=True)
+        top = []
+        for model, family, seconds, dispatches, flops in rows[:limit]:
+            achieved = flops / seconds if flops and seconds > 0 else None
+            top.append({
+                "model": model,
+                "family": family,
+                "device_seconds": round(seconds, 6),
+                "dispatches": int(dispatches),
+                "share": round(seconds / total, 4) if total > 0 else None,
+                "achieved_flops_per_s": achieved,
+                "roofline_ratio": (round(achieved / self.peak_flops, 6)
+                                   if achieved is not None
+                                   and self.peak_flops > 0 else None),
+            })
+        return {
+            "families": len(rows),
+            "device_seconds_total": round(total, 6),
+            "peak_flops": self.peak_flops or None,
+            "dropped_families": dropped,
+            "top": top,
+        }
+
+
+def charge_device_time(elapsed_s: float, model: str,
+                       classes: Optional[Sequence[str]] = None,
+                       family: Optional[str] = None,
+                       device_seconds: Optional[Dict[Tuple[str, str],
+                                                     float]] = None,
+                       metrics: Any = None,
+                       ledger: Optional[ExecutableLedger] = None,
+                       flops: Optional[float] = None) -> None:
+    """The shared dispatch-site timing helper (ISSUE 17 satellite): ONE
+    measured elapsed charges every attribution plane that wants it, so
+    the per-class aggregate and the per-family ledger cannot disagree.
+
+    - ``classes`` + ``device_seconds``/``metrics``: split ``elapsed_s``
+      evenly across the participating requests' SLO classes and charge
+      the ``{model, cls}`` aggregate (``app_tpu_device_seconds_total``)
+      — the engine path. Callers that already account the aggregate
+      elsewhere (the executor, whose duty cycle rides ``_busy_s``) pass
+      ``classes=None`` and the aggregate is untouched: no double count.
+    - ``family`` + ``ledger``: charge the full ``elapsed_s`` once to the
+      ``{model, family}`` executable row.
+    """
+    if elapsed_s <= 0:
+        return
+    if classes:
+        share = elapsed_s / len(classes)
+        for cls in classes:
+            if device_seconds is not None:
+                key = (model, cls)
+                device_seconds[key] = device_seconds.get(key, 0.0) + share
+            if metrics is not None:
+                metrics.delta_updown_counter(
+                    "app_tpu_device_seconds_total", share,
+                    model=model, cls=cls)
+    if ledger is not None and family is not None:
+        ledger.charge(model, family, elapsed_s, flops=flops)
 
 
 class ShapeStats:

@@ -35,7 +35,9 @@ from gofr_tpu.tpu.compile_ledger import (
     CAUSE_SERVING,
     CAUSE_WARMUP,
     CompileLedger,
+    ExecutableLedger,
     ShapeStats,
+    charge_device_time,
     fingerprint_lowered,
     suggest_ladder,
 )
@@ -118,6 +120,14 @@ class Executor:
         self.ledger = ledger if ledger is not None \
             else CompileLedger(metrics)
         self.shapes = ShapeStats(metrics)
+        # per-executable roofline attribution (ISSUE 17): device time and
+        # executed FLOPs per (model, bucket family), achieved vs
+        # peak_flops — the "which executable burns the seconds" view.
+        # classes=None at the charge site keeps the engine-owned
+        # app_tpu_device_seconds_total aggregate untouched (no double
+        # count; the batcher plane never charged it).
+        self.exec_ledger = ExecutableLedger(metrics,
+                                            peak_flops=self.peak_flops)
         # flight recorder for step-phase timelines (statusz); optional
         self.recorder = recorder
         # (model, bucket) -> monotonic start of an in-progress serve-time
@@ -422,6 +432,13 @@ class Executor:
             self._flops_done.add(flops)
             # only the real rows' share of the padded execute is useful
             self._flops_useful.add(flops * n / bucket)
+        # per-executable roofline ledger (ISSUE 17): the batcher plane's
+        # executables are keyed (model, bucket). classes=None — the
+        # engine owns the class-keyed aggregate; this plane never
+        # contributed to it, so charging the family view adds no double
+        # count.
+        charge_device_time(elapsed, name, family=f"b{bucket}",
+                           ledger=self.exec_ledger, flops=flops)
         return self._jax.tree.map(lambda l: np.asarray(l)[:n], out)
 
     # -- saturation telemetry ------------------------------------------------
@@ -602,6 +619,10 @@ class Executor:
             "compiles": self.ledger.snapshot(limit=recent),
             "models": models,
             "padding": self.shapes.snapshot(),
+            # per-executable roofline table (ISSUE 17): device-seconds,
+            # dispatches, achieved FLOP/s vs TPU_PEAK_FLOPS per
+            # (model, bucket family), ranked by seconds
+            "executables": self.exec_ledger.snapshot(limit=max_rungs * 3),
         }
 
     def _constrain(self, inputs: Any):

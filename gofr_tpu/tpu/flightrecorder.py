@@ -34,7 +34,7 @@ class RequestRecord:
                  "first_token_at", "finished_at", "tokens", "status",
                  "ticks", "batch_min", "batch_max", "batch_sum",
                  "cached_prefix_len", "pages_held", "kv_transfer_s",
-                 "kv_transfer_bytes")
+                 "kv_transfer_bytes", "wevent")
 
     def __init__(self, model: str = "generate", prompt_len: int = 0,
                  budget: int = 0, trace_id: Optional[str] = None,
@@ -61,6 +61,9 @@ class RequestRecord:
         # request's KV transfer — zero for locally prefilled requests
         self.kv_transfer_s = 0.0
         self.kv_transfer_bytes = 0
+        # workload capture (ISSUE 17): the TrafficRecorder admission
+        # event this request belongs to, closed at finish — shape only
+        self.wevent: Optional[Any] = None
 
     # -- event hooks (engine/batcher call these) ---------------------------
     def admitted(self) -> None:
@@ -141,6 +144,10 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 256, step_capacity: int = 128):
         self.capacity = capacity
+        # workload capture (ISSUE 17): finish() is the single funnel every
+        # terminal status passes through, so a TrafficRecorder attached
+        # here sees the finish reason for free
+        self.workload: Optional[Any] = None
         self._lock = threading.Lock()
         self._inflight: Dict[int, RequestRecord] = {}
         self._completed: "deque[RequestRecord]" = deque(maxlen=capacity)
@@ -162,6 +169,9 @@ class FlightRecorder:
         with self._lock:
             if self._inflight.pop(id(record), None) is not None:
                 self._completed.append(record)
+        workload = self.workload
+        if workload is not None:
+            workload.finish(record)
 
     def record_step(self, model: str, bucket: int, batch: int,
                     phases: Dict[str, float]) -> None:

@@ -59,7 +59,8 @@ import numpy as np
 from gofr_tpu.aio import spawn_logged
 from gofr_tpu.slo import DeadlineExceeded, current_deadline
 from gofr_tpu.tpu import faults
-from gofr_tpu.tpu.compile_ledger import ShapeStats, suggest_ladder
+from gofr_tpu.tpu.compile_ledger import (ExecutableLedger, ShapeStats,
+                                         charge_device_time, suggest_ladder)
 from gofr_tpu.tpu.constrain import GrammarWalker
 from gofr_tpu.tpu.flightrecorder import FlightRecorder, RequestRecord
 from gofr_tpu.tpu.sched import (ClassQueues, DEFAULT_CLASS_WEIGHTS,
@@ -279,18 +280,23 @@ class _Fetch:
     slo class} (ISSUE 10). ``anatomy`` is the sampled decode-tick phase
     breakdown (ISSUE 16): None on unsampled ticks; on every Nth tick the
     loop stashes host-side phase timings here and ``_publish`` completes
-    them with the device wait before handing the dict to telemetry."""
+    them with the device wait before handing the dict to telemetry.
+    ``family`` names the compiled-executable family the dispatch hit
+    (ISSUE 17) so the same elapsed window also lands in the
+    per-executable roofline ledger."""
     __slots__ = ("task", "kind", "payload", "span", "dispatched_at",
-                 "anatomy")
+                 "anatomy", "family")
 
     def __init__(self, task, kind: str, payload,
-                 span: Optional[Span] = None, anatomy=None):
+                 span: Optional[Span] = None, anatomy=None,
+                 family: Optional[str] = None):
         self.task = task
         self.kind = kind
         self.payload = payload
         self.span = span
         self.dispatched_at = time.monotonic()
         self.anatomy = anatomy
+        self.family = family
 
 
 class GenerationEngine:
@@ -704,6 +710,14 @@ class GenerationEngine:
         # {model, slo class}. Attribution, not utilization — pipelined
         # ticks overlap, so the shares can sum past wall-clock time.
         self._device_seconds: Dict[Tuple[str, str], float] = {}
+        # executable-level roofline attribution (ISSUE 17): the same
+        # dispatch→publish window, keyed by compiled-executable family
+        # instead of slo class — both views share one charge helper so
+        # their totals agree by construction
+        self.exec_ledger = ExecutableLedger(metrics=metrics)
+        # workload capture (ISSUE 17): a TrafficRecorder attached via
+        # attach_workload; None keeps admission byte-identical
+        self.workload = None
         self._prefill_bucket_tokens = 0   # bucket rows*cols dispatched to
         self._prefill_real_tokens = 0     # prefill vs real prompt tokens
         self._prefix = None
@@ -1810,6 +1824,8 @@ class GenerationEngine:
         future = asyncio.get_running_loop().create_future()
         flight = self._new_flight(prompt, max_new_tokens)
         cls = deadline_class(flight.deadline)
+        if self.workload is not None:
+            self.workload.admit(flight.record, cls, flight.deadline)
         self._brownout_gate(cls, flight)
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, None,
@@ -1841,6 +1857,8 @@ class GenerationEngine:
         future = asyncio.get_running_loop().create_future()
         flight = self._new_flight(prompt, max_new_tokens)
         cls = deadline_class(flight.deadline)
+        if self.workload is not None:
+            self.workload.admit(flight.record, cls, flight.deadline)
         self._brownout_gate(cls, flight)
         await self._pending.put((prompt, bucket, max_new_tokens, eos_id,
                                  sampling or Sampling(), future, queue,
@@ -2393,6 +2411,14 @@ class GenerationEngine:
         self.telemetry = store
         self._tick_every = max(1, int(every))
 
+    def attach_workload(self, recorder) -> None:
+        """Wire the workload capture plane (ISSUE 17): admissions call
+        ``recorder.admit`` and every terminal status reaches
+        ``recorder.finish`` through the flight recorder's single finish
+        funnel. Never called → zero-cost (``self.workload`` stays None)."""
+        self.workload = recorder
+        self.recorder.workload = recorder
+
     def stats(self) -> Dict[str, Any]:
         out = {"model": self.model_name,
                "active_slots": self.active_slots,
@@ -2635,6 +2661,9 @@ class GenerationEngine:
             "data_plane": self.data_plane(),
             "stats": self.stats(),
             "requests": self.recorder.snapshot(limit=recent),
+            # per-executable roofline attribution (ISSUE 17): the ranked
+            # top-offenders view of the same device-seconds charged above
+            "executables": self.exec_ledger.snapshot(limit=8),
         }
 
     def xlaz(self, recent: int = 64, max_rungs: int = 4) -> Dict[str, Any]:
@@ -2645,7 +2674,20 @@ class GenerationEngine:
         configured prompt buckets, and the padding-optimal ladder those
         lengths would prefer. Same schema as ``Executor.xlaz`` so the
         endpoint renders either."""
-        observed = self.shapes.distribution("prompt")
+        # ladder re-weighting (ISSUE 17): when a workload recorder is
+        # attached, the suggested-ladder DP optimizes for the RECENT
+        # traffic shape (the recorder's bounded ring) instead of lifetime
+        # observed lengths — a workload shift moves the suggestion even
+        # after months of stale history
+        ladder_source = "observed_lengths"
+        observed: Dict[int, int] = {}
+        if self.workload is not None:
+            observed = self.workload.prompt_length_distribution(
+                self.model_name)
+            if observed:
+                ladder_source = "workload_trace"
+        if not observed:
+            observed = self.shapes.distribution("prompt")
         out = {
             "models": {
                 "prompt": {
@@ -2658,9 +2700,13 @@ class GenerationEngine:
                     "suggested_ladder": suggest_ladder(
                         observed,
                         max_rungs=max(len(self.prompt_buckets), max_rungs)),
+                    "ladder_source": ladder_source,
                 },
             },
             "padding": self.shapes.snapshot(),
+            # per-executable device time vs roofline (ISSUE 17): ranked
+            # top offenders — "which compiled family burns the seconds"
+            "executables": self.exec_ledger.snapshot(limit=max_rungs * 3),
         }
         if self._prefix is not None:
             # prefix reuse multiplies the prefill-executable family by the
@@ -2849,10 +2895,12 @@ class GenerationEngine:
         t_admit = time.monotonic() if sampled else 0.0
         # 1. batched admission of everything pending (up to free slots);
         #    each prefill's first-token fetch starts concurrently
-        for first_dev, claimed, step_span in await self._admit_pending(loop):
+        for first_dev, claimed, step_span, family in \
+                await self._admit_pending(loop):
             q.append(_Fetch(loop.run_in_executor(None, np.asarray,
                                                  first_dev),
-                            "prefill", claimed, span=step_span))
+                            "prefill", claimed, span=step_span,
+                            family=family))
 
         # 2. dispatch the next decode tick(s) up to the pipeline depth;
         #    its token fetch starts immediately in its own worker thread
@@ -2862,7 +2910,7 @@ class GenerationEngine:
             t_dispatch = time.monotonic() if sampled else 0.0
             tick = await self._dispatch_tick(loop)
             if tick is not None:
-                kind, fetch, payload, step_span = tick
+                kind, fetch, payload, step_span, family = tick
                 self._ticks_inflight += 1
                 anatomy = None
                 if ts is not None:
@@ -2875,7 +2923,7 @@ class GenerationEngine:
                         }
                 q.append(_Fetch(loop.run_in_executor(None, fetch),
                                 kind, payload, span=step_span,
-                                anatomy=anatomy))
+                                anatomy=anatomy, family=family))
                 dispatched = True
 
         if not q:
@@ -2907,8 +2955,13 @@ class GenerationEngine:
 
     def _attribute_device_time(self, entry: _Fetch) -> None:
         """Charge the step's dispatch→publish wall time to the
-        participating requests' {model, slo class}, split evenly. Feeds
-        ``app_tpu_device_seconds_total`` and the hbmz/clusterz rollups."""
+        participating requests' {model, slo class}, split evenly, AND to
+        the dispatched executable family (ISSUE 17) — both through the
+        shared :func:`charge_device_time` helper, so the per-family
+        ledger and ``app_tpu_device_seconds_total`` see the exact same
+        elapsed window (the totals agree by construction, no double
+        count). Feeds the hbmz/clusterz rollups and the xlaz roofline
+        table."""
         elapsed = time.monotonic() - entry.dispatched_at
         if elapsed <= 0:
             return
@@ -2920,16 +2973,13 @@ class GenerationEngine:
             participants = [s for s, _ in entry.payload]
         if not participants:
             return
-        share = elapsed / len(participants)
-        for slot_idx in participants:
-            cls = getattr(self._slots[slot_idx], "cls", None) or "standard"
-            key = (self.model_name, cls)
-            self._device_seconds[key] = (
-                self._device_seconds.get(key, 0.0) + share)
-            if self.metrics is not None:
-                self.metrics.delta_updown_counter(
-                    "app_tpu_device_seconds_total", share,
-                    model=self.model_name, cls=cls)
+        classes = [getattr(self._slots[s], "cls", None) or "standard"
+                   for s in participants]
+        charge_device_time(
+            elapsed, self.model_name, classes=classes,
+            family=entry.family or entry.kind,
+            device_seconds=self._device_seconds, metrics=self.metrics,
+            ledger=self.exec_ledger)
 
     def _publish(self, entry: _Fetch, host) -> None:
         self._attribute_device_time(entry)
@@ -3065,8 +3115,9 @@ class GenerationEngine:
         (prefix-pages, prompt-length-bucket) group — prefix_pages is 0
         (full prefill, publishing its pages back to the prefix store when
         one is configured) or a prefix-ladder rung (suffix-only prefill
-        gathering cached pages). Returns [(first_dev, [(slot, gen, row)])]
-        fetch handles for the first generated tokens."""
+        gathering cached pages). Returns [(first_dev, [(slot, gen, row)],
+        step_span, family)] fetch handles for the first generated
+        tokens."""
         requests: List[Tuple] = []
         # page-deferred requests re-enter FIRST (FIFO fairness: they were
         # admitted-in-order before the pool ran short)
@@ -3078,7 +3129,7 @@ class GenerationEngine:
             return []
         jnp = self._jnp
         fetches: List[Tuple[Any, List[Tuple[int, int, int]],
-                            Optional[Span]]] = []
+                            Optional[Span], str]] = []
         by_group: Dict[Tuple[int, int, bool], List[Tuple]] = {}
         leases: List[Any] = []
         committed = 0      # pages promised to requests admitted this pass
@@ -3522,7 +3573,9 @@ class GenerationEngine:
                     first_dev = await loop.run_in_executor(None, cold)
                 self._prefills += 1
                 self._prefill_bucket_tokens += nb * bucket
-                fetches.append((first_dev, claimed, step_span))
+                family = (f"suffix_prefill[nb={nb},p={p_rung},b={bucket}]"
+                          if p_rung else f"prefill[nb={nb},b={bucket}]")
+                fetches.append((first_dev, claimed, step_span, family))
         finally:
             if self._prefix is not None and leases:
                 self._prefix.release(leases)
@@ -3794,7 +3847,13 @@ class GenerationEngine:
         def fetch(dev=tokens_dev):
             return np.asarray(dev)
 
-        return "tick", fetch, snapshot, step_span
+        # executable-family name for the roofline ledger (ISSUE 17):
+        # mirrors the warm-key above, so device time lands on the same
+        # granularity the compiler cache is keyed by
+        tag = "_bias" if biased else ""
+        family = (f"decode_paged{tag}[k={k},pw={pw}]" if self.paged
+                  else f"decode{tag}[k={k},w={window or self.max_len}]")
+        return "tick", fetch, snapshot, step_span, family
 
     async def _dispatch_spec(self, loop, eligible, g: int):
         """Dispatch one speculative tick at rung ``g``: charge every
@@ -3879,7 +3938,9 @@ class GenerationEngine:
         def fetch(pair=pair):
             return np.asarray(pair[0]), np.asarray(pair[1])
 
-        return "spec", fetch, (snapshot, g), step_span
+        family = (f"spec_paged[g={g},pw={pw}]" if self.paged
+                  else f"spec[g={g},w={window or self.max_len}]")
+        return "spec", fetch, (snapshot, g), step_span, family
 
     def _cover_pages(self, eligible, k: int):
         """Grow each participating slot's page chain to cover its fill + k

@@ -41,6 +41,10 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py || exi
 # the fused Pallas kernel (interpret mode), width-ladder retirement in
 # the ledger, sentinel pages never dereferenced (NaN poisoning)
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/ragged_attn_smoke.py || exit 1
+# workload capture & replay smoke: live traffic recorded shape-only,
+# exported trace replayed twice deterministically (identical digests),
+# executable-family device seconds agree with the per-class aggregate
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/replay_smoke.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

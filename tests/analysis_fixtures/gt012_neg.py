@@ -1,0 +1,39 @@
+"""GT012 negative fixture: shape-only workload capture — every store
+keeps lengths, counts, and labels; content passes only through the
+sanctioned shape extractors (len/min/max/sum/int/float/bool/hash)."""
+
+from collections import deque
+
+
+class ShapeOnlyRecorder:
+    def __init__(self):
+        self._ring = deque(maxlen=64)
+        self._classes = {}
+
+    def admit(self, request, cls):
+        # shape only: the length leaves len(), never the ids themselves
+        self._ring.append({
+            "prompt_len": len(request.prompt_ids),
+            "budget": int(request.budget),
+            "cls": cls,
+        })
+        self._classes[cls] = self._classes.get(cls, 0) + 1
+
+    def finish(self, request, event):
+        # output token COUNT, finish label — both shape
+        event["output_len"] = len(request.tokens)
+        event["finish"] = request.status
+
+    def snapshot(self):
+        lens = [event["prompt_len"] for event in self._ring]
+        return {
+            "window": len(lens),
+            "mean_prompt_len": (sum(lens) / len(lens)) if lens else None,
+            "class_mix": dict(self._classes),
+        }
+
+    def export_trace(self):
+        rows = []
+        for event in self._ring:
+            rows.append([event["prompt_len"], event["budget"]])
+        return {"version": 1, "events": rows}

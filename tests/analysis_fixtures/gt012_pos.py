@@ -1,0 +1,34 @@
+"""GT012 positive fixture: workload-plane code that stores request
+CONTENT — token ids, prompt strings, request bodies — where only shape
+(lengths, counts, labels) is allowed. Scanned with scope_all=True."""
+
+from collections import deque
+
+
+class LeakyRecorder:
+    def __init__(self):
+        self._ring = deque(maxlen=64)
+        self._last_body = None
+
+    def admit(self, request):
+        # leak 1: raw prompt token ids appended into the persistent ring
+        self._ring.append(request.prompt_ids)
+        # leak 2: the whole request body parked on the instance
+        self._last_body = request.body
+
+    def snapshot(self):
+        rows = []
+        for event in self._ring:
+            # leak 3: an export path serializing the prompt string
+            rows.append({"len": len(event), "prompt": event})
+        return rows
+
+    def export_trace(self):
+        # leak 4: content-named key written into the exported dict
+        out = {}
+        out["text"] = self._last_body
+        return out
+
+    def sanctioned_forensics(self, request):
+        # a deliberate, reviewed exception rides the pragma
+        self._ring.append(request.tokens)  # graftcheck: ignore[GT012]

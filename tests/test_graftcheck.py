@@ -400,7 +400,7 @@ def test_cli_list_rules_covers_catalog():
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
         {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007",
-         "GT008", "GT009", "GT010", "GT011"}
+         "GT008", "GT009", "GT010", "GT011", "GT012"}
 
 
 def test_lint_metrics_shim_still_works():
@@ -450,4 +450,43 @@ def test_gt011_scoping_skips_non_telemetry_modules_by_default():
     # is out of scope: the rule only patrols metrics/trace packages and
     # telemetry-named modules
     report = scan("gt011_pos.py", "GT011")
+    assert report.new_findings == []
+
+
+# -- GT012 workload content leak ----------------------------------------------
+
+def test_gt012_positive_flags_content_stores():
+    report = scan("gt012_pos.py", "GT012", scope_all=True)
+    got = keys(report)
+    assert "workload content leak 'prompt_ids'" in got  # ring append
+    assert "workload content leak 'body'" in got        # instance attr
+    assert "workload content leak 'prompt'" in got      # export dict key
+    assert "workload content leak 'text'" in got        # subscript store
+    assert all(f.rule == "GT012" and f.severity == "error"
+               for f in report.new_findings)
+    # the pragma'd forensics store is suppressed, not reported
+    assert "workload content leak 'tokens'" not in got
+    assert report.suppressed >= 1
+
+
+def test_gt012_negative_shape_only_recorder_is_clean():
+    report = scan("gt012_neg.py", "GT012", scope_all=True)
+    assert report.new_findings == []
+    assert report.exit_code == 0
+
+
+def test_gt012_scoping_skips_non_workload_modules_by_default():
+    # without scope_all the fixture path is out of scope: the rule only
+    # patrols workload-named modules/packages
+    report = scan("gt012_pos.py", "GT012")
+    assert report.new_findings == []
+
+
+def test_gt012_repo_workload_plane_scans_clean():
+    # the real recorder/endpoint must hold the shape-only invariant
+    rules = default_rules(select=["GT012"])
+    report = engine.run(
+        paths=[REPO / "gofr_tpu" / "tpu" / "workload.py",
+               REPO / "gofr_tpu" / "workloadz.py"],
+        rules=rules, baseline={})
     assert report.new_findings == []
