@@ -218,6 +218,16 @@ REGRESSION_NOTES = {
         "micro-scenario through the relay: the absolute number swings "
         "with relay health — judge staged vs unstaged and coalesced vs "
         "per-array within the SAME run, not across rounds"),
+    "llama_sloz_verdict_admission": (
+        "new in r16 (whyz diagnosis plane): 1 iff the induced queue-wait "
+        "regression's worst offender is diagnosed admission_backlog with "
+        "the admission depth named — asserted in-artifact, a 0 fails "
+        "the round"),
+    "llama_sloz_queue_wait_share": (
+        "new in r16: queue.wait / e2e of the burst arm's worst offender "
+        "on a single-slot engine — the induced regression pushes this "
+        "toward 1; a drop means admission wait is no longer the story "
+        "the diagnosis must tell"),
 }
 
 _LEDGER_PATHS = {
@@ -259,6 +269,10 @@ _LEDGER_PATHS = {
     "llama_replay_deterministic": ("llama_replay", "deterministic"),
     "llama_replay_attribution_gap_pct": ("llama_replay",
                                          "attribution_gap_pct"),
+    "llama_sloz_verdict_admission": ("llama_sloz",
+                                     "verdict_names_admission"),
+    "llama_sloz_queue_wait_share": ("llama_sloz",
+                                    "worst_queue_wait_share"),
     "llama_batch_lane_tok_s_soaked": ("llama_batch_lane",
                                       "batch_tok_s_soaked"),
     "llama_batch_lane_interactive_ratio": ("llama_batch_lane",
@@ -344,6 +358,7 @@ def main() -> None:
     llama_fleet = _llama_fleet_bench(on_tpu)
     llama_chaos = _llama_chaos_bench(on_tpu)
     llama_replay = _llama_replay_bench(on_tpu)
+    llama_sloz = _llama_sloz_bench(on_tpu)
     multi_model = _multi_model_bench(on_tpu)
     llama_batch_lane = _llama_batch_lane_bench(on_tpu)
     llama7b = _llama7b_int8_bench(on_tpu)
@@ -370,6 +385,7 @@ def main() -> None:
         "llama_fleet": llama_fleet,
         "llama_chaos": llama_chaos,
         "llama_replay": llama_replay,
+        "llama_sloz": llama_sloz,
         "multi_model": multi_model,
         "llama_batch_lane": llama_batch_lane,
         "llama7b_int8": llama7b,
@@ -2148,6 +2164,122 @@ def _llama_replay_bench(on_tpu: bool):
                  "recorded lengths with per-index seeds and decode with "
                  "eos_id=None, so admitted tokens are pinned by the "
                  "trace — compare replay_tok_s within a round only"),
+    }
+
+
+def _llama_sloz_bench(on_tpu: bool):
+    """Slow-request diagnosis plane (ISSUE 18, docs/quick-start/
+    observability.md "whyz"): induce a queue-wait regression — the same
+    request mix run sequentially (no admission contention) and then as
+    one concurrent burst into a slot-starved engine — and check the
+    worst-offender ring's finish-time verdict blames admission, not the
+    device. Priced:
+
+    - ``verdict_names_admission`` — 1 iff the burst arm's worst
+      offender's top verdict is ``admission_backlog`` and its cause
+      names the admission depth. This is the ISSUE 18 acceptance bar
+      (a diagnosis that misattributes a pure queueing regression to
+      the model is worse than no diagnosis); asserted in-artifact.
+    - ``worst_queue_wait_share`` — queue.wait seconds / e2e of that
+      worst offender; the induced regression should push this near 1.
+    - ``diagnose_us_per_call`` — the rule table re-run on the captured
+      record + a fresh window context; the per-request cost the ring
+      pays at finish time (host-only, no device work)."""
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.diagnose import (WorstOffenders,
+                                       build_window_context, diagnose)
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    if on_tpu:
+        preset, max_len, buckets, page = "small", 512, (64,), 32
+        prompt_len = 24
+    else:
+        preset, max_len, buckets, page = "tiny", 64, (8,), 4
+        prompt_len = 6
+    cfg = llama.config(preset)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    n_requests, budget = 12, 6
+    prompts = [[(5 * i + 3 * j) % 250 + 1 for j in range(prompt_len)]
+               for i in range(n_requests)]
+
+    container = new_mock_container()
+    # max_slots=1 is the regression lever: a concurrent burst can only
+    # be served one request at a time, so every non-head request's
+    # latency is admission wait — exactly the shape whyz must name
+    engine = GenerationEngine(
+        cfg, params, max_slots=1, max_len=max_len,
+        prompt_buckets=buckets, kv_page=page, paged_kv=True,
+        steps_per_tick=4, model_name="llama-sloz",
+        logger=container.logger, metrics=container.metrics)
+    ring = WorstOffenders(
+        k=8, window_s=600.0, keep_windows=2,
+        context_fn=lambda: build_window_context(engine=engine))
+
+    sequential_s: list = []
+    burst = {}
+
+    async def run() -> None:
+        await engine.start()
+        try:
+            # warm the compile ladder so neither arm times a compile
+            await engine.generate(prompts[0], max_new_tokens=budget)
+            # baseline arm: one request at a time, no contention
+            for prompt in prompts:
+                t0 = time.perf_counter()
+                await engine.generate(prompt, max_new_tokens=budget)
+                sequential_s.append(time.perf_counter() - t0)
+            # regression arm: the same mix as one burst, diagnosed at
+            # finish time by the offender ring
+            engine.recorder.offenders = ring
+            t0 = time.perf_counter()
+            await asyncio.gather(*[
+                engine.generate(prompt, max_new_tokens=budget)
+                for prompt in prompts])
+            burst["elapsed_s"] = time.perf_counter() - t0
+        finally:
+            await engine.stop()
+
+    asyncio.run(run())
+    worst = ring.worst()
+    assert worst is not None, "offender ring recorded nothing"
+    top = worst["verdicts"][0]
+    names_admission = int(top["rule"] == "admission_backlog"
+                          and "admission depth" in top["cause"])
+    assert names_admission, worst["verdicts"]
+    share = (top["phase_s"]["queue.wait"] / worst["e2e_s"]
+             if worst["e2e_s"] else None)
+
+    # diagnosis cost: the rule table over the captured record + a fresh
+    # window snapshot, the work offer() does once per ring admission
+    ctx = build_window_context(engine=engine)
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        diagnose(worst["record"], ctx)
+    diagnose_us = (time.perf_counter() - t0) / reps * 1e6
+
+    sequential_s.sort()
+    return {
+        "preset": preset,
+        "requests": n_requests,
+        "sequential_p50_s": round(
+            sequential_s[len(sequential_s) // 2], 4),
+        "burst_elapsed_s": round(burst["elapsed_s"], 4),
+        "worst_e2e_s": worst["e2e_s"],
+        "worst_queue_wait_share": (round(share, 3)
+                                   if share is not None else None),
+        # acceptance: the induced admission regression is named as such
+        "verdict_names_admission": names_admission,
+        "top_verdict": top["cause"],
+        "dominant_phase": top["dominant_phase"],
+        "diagnose_us_per_call": round(diagnose_us, 1),
+        "note": ("single-slot engine + concurrent burst makes queue.wait "
+                 "the dominant phase by construction; judge "
+                 "worst_queue_wait_share and the verdict within a run — "
+                 "absolute latencies ride host load"),
     }
 
 

@@ -235,6 +235,8 @@ def build_app():
     app.enable_hbmz()           # device-memory attribution + watchdog HBM
     app.enable_timez()          # multi-res series + anomalies + tick anatomy
     app.enable_workloadz()      # traffic-shape ring + trace export + roofline
+    app.enable_sloz()           # error-budget burn rates + worst offenders
+    app.enable_whyz()           # per-trace slow-request root-cause verdicts
     app.enable_profiler()       # duration-capped on-demand XLA captures
 
     @app.on_startup

@@ -19,6 +19,8 @@ from gofr_tpu.analysis.rules.gt010_retry import UnboundedRetryRule
 from gofr_tpu.analysis.rules.gt011_telemetry import \
     UnboundedTelemetryBufferRule
 from gofr_tpu.analysis.rules.gt012_workload import WorkloadContentLeakRule
+from gofr_tpu.analysis.rules.gt013_watchdog_reasons import \
+    WatchdogReasonDriftRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -33,19 +35,22 @@ ALL_RULES = (
     UnboundedRetryRule,
     UnboundedTelemetryBufferRule,
     WorkloadContentLeakRule,
+    WatchdogReasonDriftRule,
 )
 
 
 def default_rules(select: Optional[Sequence[str]] = None,
                   **options) -> List[Rule]:
     """Instantiate the rule set, optionally filtered to ``select`` ids.
-    ``options`` are forwarded to rules that accept them (GT005 takes
-    ``docs_catalog``, GT011/GT012 take ``scope_all``)."""
+    ``options`` are forwarded to rules that accept them (GT005/GT013
+    take ``docs_catalog``, GT011/GT012 take ``scope_all``)."""
     rules: List[Rule] = []
     for cls in ALL_RULES:
         if select and cls.rule_id not in select:
             continue
         if cls is MetricDisciplineRule and "docs_catalog" in options:
+            rules.append(cls(docs_catalog=options["docs_catalog"]))
+        elif cls is WatchdogReasonDriftRule and "docs_catalog" in options:
             rules.append(cls(docs_catalog=options["docs_catalog"]))
         elif cls is UnboundedTelemetryBufferRule and "scope_all" in options:
             rules.append(cls(scope_all=options["scope_all"]))

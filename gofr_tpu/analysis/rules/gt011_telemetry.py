@@ -54,7 +54,8 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 from gofr_tpu.analysis.engine import Finding, ModuleInfo, Rule
 
 _SCOPE_DIRS = {"metrics", "trace"}
-_SCOPE_STEMS = ("telemetry", "timeseries", "timez", "tracer", "workload")
+_SCOPE_STEMS = ("telemetry", "timeseries", "timez", "tracer", "workload",
+                "diagnose")
 _HOT_NAME = re.compile(
     r"(record|observe|add|note|sample|ingest|track|append|push|emit"
     r"|publish|on_|handle|fire|mark)")

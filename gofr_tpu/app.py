@@ -83,6 +83,10 @@ class App:
         self._tasks: List[asyncio.Task] = []
         self._startup_hooks: List[Callable] = []
         self._shutdown_hooks: List[Callable] = []
+        # debug-surface registry (ISSUE 18): every enable_* records its
+        # path + one-line description here; /debug/ renders the index so
+        # operators stop guessing endpoint names
+        self._debug_surfaces: Dict[str, str] = {}
         self._shutdown: Optional[asyncio.Event] = None  # created in start()
         self._install_default_middleware()
 
@@ -225,46 +229,101 @@ class App:
     def enable_profiler(self, prefix: str = "/debug/profiler") -> None:
         from gofr_tpu.profiler import enable_profiler
         enable_profiler(self, prefix)
+        self._note_debug_surface(
+            prefix, "on-demand single-flight device trace capture")
 
     # -- flight recorder statusz (no reference analog; statusz.py) ----------
     def enable_statusz(self, prefix: str = "/debug/statusz") -> None:
         from gofr_tpu.statusz import enable_statusz
         enable_statusz(self, prefix)
+        self._note_debug_surface(
+            prefix, "live serving state: queues, slots, flight records, "
+                    "watchdog, KV occupancy")
 
     # -- SLO/saturation varz (no reference analog; varz.py) -----------------
     def enable_varz(self, prefix: str = "/debug/varz") -> None:
         from gofr_tpu.varz import enable_varz
         enable_varz(self, prefix)
+        self._note_debug_surface(
+            prefix, "windowed SLO attainment, goodput, and device "
+                    "saturation rates")
 
     # -- compile/shape-plane xlaz (no reference analog; xlaz.py) ------------
     def enable_xlaz(self, prefix: str = "/debug/xlaz") -> None:
         from gofr_tpu.xlaz import enable_xlaz
         enable_xlaz(self, prefix)
+        self._note_debug_surface(
+            prefix, "compile ledger, bucket ladders, and padding-optimal "
+                    "ladder suggestions")
 
     # -- fleet rollup clusterz (no reference analog; clusterz.py) -----------
     def enable_clusterz(self, prefix: str = "/debug/clusterz") -> None:
         from gofr_tpu.clusterz import enable_clusterz
         enable_clusterz(self, prefix)
+        self._note_debug_surface(
+            prefix, "fleet rollup: per-replica health, per-role "
+                    "aggregates, router stats")
 
     # -- cross-replica trace stitching (clusterz.py) ------------------------
     def enable_tracez(self, prefix: str = "/debug/tracez") -> None:
         from gofr_tpu.clusterz import enable_tracez
         enable_tracez(self, prefix)
+        self._note_debug_surface(
+            f"{prefix}/{{trace_id}}",
+            "cross-replica stitched timeline for one trace id")
 
     # -- HBM attribution hbmz (no reference analog; hbmz.py) ----------------
     def enable_hbmz(self, prefix: str = "/debug/hbmz") -> None:
         from gofr_tpu.hbmz import enable_hbmz
         enable_hbmz(self, prefix)
+        self._note_debug_surface(
+            prefix, "HBM attribution: per-tenant KV pages, pools, "
+                    "residual accounting")
 
     # -- time-series telemetry timez (no reference analog; timez.py) --------
     def enable_timez(self, prefix: str = "/debug/timez") -> None:
         from gofr_tpu.timez import enable_timez
         enable_timez(self, prefix)
+        self._note_debug_surface(
+            prefix, "multi-resolution time series, anomalies, and "
+                    "sampled tick anatomy")
 
     # -- workload capture workloadz (no reference analog; workloadz.py) -----
     def enable_workloadz(self, prefix: str = "/debug/workloadz") -> None:
         from gofr_tpu.workloadz import enable_workloadz
         enable_workloadz(self, prefix)
+        self._note_debug_surface(
+            prefix, "shape-only workload capture and per-executable "
+                    "roofline attribution")
+
+    # -- error-budget burn rates sloz (ISSUE 18; sloz.py) -------------------
+    def enable_sloz(self, prefix: str = "/debug/sloz") -> None:
+        from gofr_tpu.sloz import enable_sloz
+        enable_sloz(self, prefix)
+        self._note_debug_surface(
+            prefix, "error-budget burn rates per (model, SLO class) and "
+                    "the worst-offender ring")
+
+    # -- slow-request diagnosis whyz (ISSUE 18; whyz.py) --------------------
+    def enable_whyz(self, prefix: str = "/debug/whyz") -> None:
+        from gofr_tpu.whyz import enable_whyz
+        enable_whyz(self, prefix)
+        self._note_debug_surface(
+            f"{prefix}/{{trace_id}}",
+            "automated root-cause verdicts for one slow request")
+
+    # -- debug index (ISSUE 18): every enabled surface on one page ----------
+    def _note_debug_surface(self, path: str, description: str) -> None:
+        self._debug_surfaces[path] = description
+        routes = set(self.router.registered_routes)
+        if "GET /debug/" not in routes:
+            self.get("/debug/", lambda ctx: self.debug_index())
+
+    def debug_index(self) -> Dict[str, str]:
+        """The ``/debug/`` index payload: every enabled debug surface
+        with its one-line description, sorted by path."""
+        return {path: self._debug_surfaces[path]
+                for path in sorted(self._debug_surfaces)}
 
     # -- external DB injection (externalDB.go:5-39) -------------------------
     def add_mongo(self, client=None) -> None:
@@ -486,7 +545,58 @@ class App:
             self.container.watchdog.brownout = new_brownout(
                 self.config, self.container.tpu,
                 metrics=self.container.metrics, logger=self.logger)
+
+        # error-budget burn-rate plane (ISSUE 18): multi-window burn
+        # evaluation differencing the labelled app_tpu_slo_total series
+        # through the telemetry store. Feeds the watchdog (DEGRADED
+        # names the burning class/window) and gates brownout escalation
+        # on a fast window actually burning.
+        from gofr_tpu.slo_budget import new_error_budget
+        self.container.slo_budget = new_error_budget(
+            self.config, self.container.telemetry, self.container.metrics,
+            logger=self.logger)
+        if self.container.slo_budget is not None \
+                and self.container.watchdog is not None:
+            self.container.watchdog.budget_fn = \
+                self.container.slo_budget.watchdog_reasons
+            if self.container.watchdog.brownout is not None:
+                self.container.watchdog.brownout.escalation_gate = \
+                    self.container.slo_budget.fast_burning
+        if self.container.slo_budget is not None \
+                and self.container.tpu is not None \
+                and hasattr(self.container.tpu, "stats"):
+            # same attachment pattern as telemetry: the in-proc cluster
+            # probe reads the engine, so the fleet rollup sees burn rates
+            self.container.tpu.slo_budget = self.container.slo_budget
+        if self.container.watchdog is not None:
             self.container.watchdog.start()
+
+        # worst-offender ring (ISSUE 18): top-K slowest requests per
+        # window, diagnosed at finish time against the live window
+        # context — attached to every flight recorder the serving layer
+        # wired (engine, or registry of engines).
+        from gofr_tpu.tpu.diagnose import build_window_context, new_offenders
+        tpu = self.container.tpu
+        engine = tpu if tpu is not None and hasattr(tpu, "stats") else None
+        ledger = getattr(tpu, "ledger", None) if tpu is not None else None
+        xledger = getattr(tpu, "exec_ledger", None) if tpu is not None \
+            else None
+        context_fn = (lambda: build_window_context(
+            engine=engine, store=self.container.telemetry,
+            ledger=ledger, xledger=xledger))
+        self.container.offenders = new_offenders(
+            self.config, context_fn=context_fn, logger=self.logger)
+        if self.container.offenders is not None and tpu is not None:
+            recorders = []
+            if getattr(tpu, "recorder", None) is not None:
+                recorders.append(tpu.recorder)
+            else:
+                for entry in (getattr(tpu, "_entries", None) or {}).values():
+                    recorder = getattr(entry.engine, "recorder", None)
+                    if recorder is not None:
+                        recorders.append(recorder)
+            for recorder in recorders:
+                recorder.offenders = self.container.offenders
 
         # async inference lane (ISSUE 11): BATCH_LANE_TOPIC turns the
         # pub/sub broker into a generation-job source feeding the WFQ

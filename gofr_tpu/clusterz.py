@@ -45,7 +45,10 @@ def _extract_view(observation: Dict[str, Any]) -> Dict[str, Any]:
                             "active_slots": None,
                             "queue_depth": None,
                             "watchdog": None,
-                            "device_seconds": None}
+                            "device_seconds": None,
+                            "max_burn_rate": None,
+                            "min_budget_remaining": None,
+                            "burning": None}
     statusz = observation.get("statusz") or {}
     slo = observation.get("slo") or statusz.get("slo") or {}
     window = slo.get("60s") or {}
@@ -68,6 +71,23 @@ def _extract_view(observation: Dict[str, Any]) -> Dict[str, Any]:
         }
     if stats.get("device_seconds"):
         view["device_seconds"] = stats["device_seconds"]
+    # error-budget burn rollup (ISSUE 18): the replica's statusz already
+    # carries its /debug/sloz evaluation — lift the worst burn, the
+    # tightest remaining budget, and any burning verdicts into the fleet
+    # view so the hot replica is findable without N per-replica fetches
+    budget = (observation.get("slo_budget")
+              or statusz.get("slo_budget") or {})
+    entries = budget.get("budgets") or []
+    burns = [b for entry in entries
+             for b in (entry.get("burn") or {}).values() if b is not None]
+    if burns:
+        view["max_burn_rate"] = round(max(burns), 3)
+    remaining = [entry["budget_remaining"] for entry in entries
+                 if entry.get("budget_remaining") is not None]
+    if remaining:
+        view["min_budget_remaining"] = min(remaining)
+    if budget.get("burning"):
+        view["burning"] = list(budget["burning"])
     return view
 
 
@@ -119,6 +139,10 @@ async def build_clusterz(cluster, router=None,
                    if r.get("goodput_tokens_per_s") is not None]
         occupancy = [r["pool_occupancy"] for r in fresh
                      if r.get("pool_occupancy") is not None]
+        burn = [r["max_burn_rate"] for r in fresh
+                if r.get("max_burn_rate") is not None]
+        burning = [n for n in names
+                   if not replicas[n]["stale"] and replicas[n].get("burning")]
         roles[role] = {
             "replicas": names,
             "stale": [n for n in names if replicas[n]["stale"]],
@@ -127,6 +151,10 @@ async def build_clusterz(cluster, router=None,
             "goodput_tokens_per_s": (round(sum(goodput), 3)
                                      if goodput else None),
             "max_pool_occupancy": (max(occupancy) if occupancy else None),
+            # worst burn across the role's fresh replicas + which
+            # replicas have a burning budget pair right now (ISSUE 18)
+            "max_burn_rate": (max(burn) if burn else None),
+            "burning": burning,
         }
 
     out: Dict[str, Any] = {

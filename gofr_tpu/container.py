@@ -67,6 +67,14 @@ class Container:
         # TrafficRecorder, created by App.start (TRAFFIC_REC_*);
         # /debug/workloadz and the replay harness read it here
         self.workload = None
+        # SLO error-budget burn-rate plane (ISSUE 18): created by
+        # App.start (SLO_BUDGET_*/SLO_OBJECTIVE_*); /debug/sloz and the
+        # watchdog's budget_fn read it here
+        self.slo_budget = None
+        # worst-offender ring (ISSUE 18): top-K slowest requests per
+        # window with finish-time diagnoses, created by App.start
+        # (WHYZ_*); /debug/whyz and /debug/sloz read it here
+        self.offenders = None
 
         self._start_time = time.time()
 
@@ -169,6 +177,22 @@ class Container:
         metrics.new_counter(
             "app_tpu_slo_total",
             "terminal requests by deadline outcome (ok|violated|expired)")
+        # error-budget burn plane (ISSUE 18): derived from the labelled
+        # app_tpu_slo_total series through the telemetry store — no
+        # second counting path
+        metrics.new_gauge(
+            "app_tpu_slo_budget_remaining",
+            "fraction of the (model, class) error budget left over the "
+            "accounting window")
+        metrics.new_gauge(
+            "app_tpu_slo_burn_rate",
+            "error-budget burn multiple per (model, class, window); 1.0 "
+            "spends exactly the budget over the objective period")
+        metrics.new_histogram(
+            "app_tpu_deadline_violation_seconds",
+            "how late past its deadline a violated request finished (s); "
+            "bucket exemplars carry the trace id for /debug/whyz",
+            (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0))
         metrics.new_gauge("app_tpu_tokens_per_s",
                           "raw generated tokens/s over the rolling window")
         metrics.new_gauge(

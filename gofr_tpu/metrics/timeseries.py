@@ -404,6 +404,26 @@ class TimeSeriesStore:
         return reasons
 
     # -- read paths ---------------------------------------------------------
+    def window_mean(self, name: str, window_s: float,
+                    now: Optional[float] = None) -> Optional[float]:
+        """Count-weighted mean of one signal over the trailing
+        ``window_s``, read from the *finest* tier whose span can cover
+        the window (1s up to 10 minutes, 10s up to 1 hour, 60s up to 4
+        hours). Every tier ingests every sample, so the mean is
+        tier-consistent: a window that hops from the 10s ring to the
+        60s ring sees the same count-weighted samples, just coarser
+        bucket boundaries — the property the burn-rate plane's
+        tier-boundary tests pin down (ISSUE 18). None when the signal
+        is unknown or the window holds nothing."""
+        signal = self._signals.get(name)
+        if signal is None:
+            return None
+        now = time.monotonic() if now is None else now
+        for (_, bucket_s, capacity), ring in zip(TIERS, signal.rings):
+            if bucket_s * capacity >= window_s:
+                return ring.window_mean(window_s, now)
+        return signal.rings[-1].window_mean(window_s, now)
+
     def series(self, tier: str = "10s",
                signals: Optional[Iterable[str]] = None,
                limit: Optional[int] = None) -> Dict[str, Any]:

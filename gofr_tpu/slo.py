@@ -138,12 +138,23 @@ class SLOTracker:
 
     def record_outcome(self, outcome: str, tokens: float = 0.0,
                        now: Optional[float] = None,
-                       cls: Optional[str] = None) -> None:
+                       cls: Optional[str] = None,
+                       model: Optional[str] = None,
+                       trace_id: Optional[str] = None,
+                       late_by_s: Optional[float] = None) -> None:
         """One request reached a terminal state. ``tokens`` is the
         request's total generated tokens; only ``ok`` completions count
         toward goodput. ``cls`` (SLO class from ``tpu.sched``) adds the
         event to the per-class views used by weighted-fair scheduling
-        dashboards — omitted, the event stays aggregate-only."""
+        dashboards — omitted, the event stays aggregate-only. ``model``
+        plus ``cls`` additionally mirror the event into the labelled
+        ``app_tpu_slo_total{model,cls,outcome}`` series the error-budget
+        burn-rate plane (ISSUE 18) differences; the bare ``{outcome}``
+        series stays the all-up aggregate including unlabelled callers.
+        For ``violated`` outcomes ``late_by_s`` (seconds past deadline)
+        lands in ``app_tpu_deadline_violation_seconds`` with ``trace_id``
+        as its OpenMetrics exemplar, so a burn-rate alert links straight
+        to one concrete slow request in /debug/whyz."""
         counter = self.outcomes.get(outcome)
         if counter is None:
             return
@@ -165,6 +176,15 @@ class SLOTracker:
                 goodput.add(tokens, now=now)
         if self.metrics is not None:
             self.metrics.increment_counter("app_tpu_slo_total", outcome=outcome)
+            if model is not None or cls is not None:
+                self.metrics.increment_counter(
+                    "app_tpu_slo_total", outcome=outcome,
+                    model=model or "", cls=cls or "")
+            if outcome == OUTCOME_VIOLATED and late_by_s is not None:
+                self.metrics.record_histogram(
+                    "app_tpu_deadline_violation_seconds", max(0.0, late_by_s),
+                    exemplar=({"trace_id": trace_id} if trace_id else None),
+                    model=model or "", cls=cls or "")
 
     # -- derived views ------------------------------------------------------
     def attainment(self, window_s: float = 60.0,
@@ -256,7 +276,8 @@ class Watchdog:
                  hbm_fn: Any = None,
                  max_hbm_occupancy: Optional[float] = None,
                  brownout: Any = None,
-                 anomaly_fn: Any = None):
+                 anomaly_fn: Any = None,
+                 budget_fn: Any = None):
         self.slo = slo
         self.metrics = metrics
         self.logger = logger
@@ -293,6 +314,13 @@ class Watchdog:
         # a goodput cliff detected against the replica's own baseline
         # names the offending signal right here in statusz.
         self.anomaly_fn = anomaly_fn
+        # error-budget burn signal (ISSUE 18): ``budget_fn`` returns a
+        # list of reason strings for (model, cls) error budgets whose
+        # multi-window burn rates are simultaneously above threshold
+        # (ErrorBudgetPlane.watchdog_reasons). The reason names the
+        # burning class and window pair, so DEGRADED in statusz reads as
+        # a budget verdict, not a bare threshold crossing.
+        self.budget_fn = budget_fn
         self.window_s = window_s
         self.interval_s = interval_s
         self.hysteresis = max(1, int(hysteresis))
@@ -348,6 +376,15 @@ class Watchdog:
             except Exception:
                 anomaly_reasons = ()
             reasons.extend(anomaly_reasons)
+        # error-budget burn: like the anomaly feed, the plane applied
+        # its own multi-window gating (short AND long window burning),
+        # so every reason here is a sustained budget drain
+        if self.budget_fn is not None:
+            try:
+                budget_reasons = self.budget_fn()
+            except Exception:
+                budget_reasons = ()
+            reasons.extend(budget_reasons)
         self._last_reasons = reasons
         if self.brownout is not None:
             self.brownout.observe(bool(reasons))
@@ -460,10 +497,28 @@ class BrownoutLadder:
         self.role = role
         self.escalate_after = max(1, int(escalate_after))
         self.recover_after = max(1, int(recover_after))
+        # error-budget escalation gate (ISSUE 18): when set, climbing a
+        # rung additionally requires the gate to answer True — the app
+        # wires ErrorBudgetPlane.fast_burning here, so shedding only
+        # tightens while a fast burn window is actually draining budget
+        # (pressure without burn holds the current rung instead of
+        # ratcheting). Descent is never gated: recovery must not depend
+        # on the budget plane being healthy.
+        self.escalation_gate: Any = None
         self.level = 0
         self.transitions = 0
         self._pressed = 0
         self._calm = 0
+        self._gate_held = 0
+
+    def _escalation_allowed(self) -> bool:
+        if self.escalation_gate is None:
+            return True
+        try:
+            return bool(self.escalation_gate())
+        except Exception:
+            # a broken gate must not freeze load shedding
+            return True
 
     def observe(self, pressure: bool) -> int:
         """Feed one watchdog evaluation; returns the (possibly new)
@@ -473,8 +528,13 @@ class BrownoutLadder:
             self._calm = 0
             if (self._pressed >= self.escalate_after
                     and self.level < self.MAX_LEVEL):
-                self._pressed = 0
-                self._set(self.level + 1)
+                if self._escalation_allowed():
+                    self._pressed = 0
+                    self._set(self.level + 1)
+                else:
+                    # hold the rung; keep _pressed so the next clear
+                    # gate answer escalates without re-accumulating
+                    self._gate_held += 1
         else:
             self._calm += 1
             self._pressed = 0
@@ -517,6 +577,8 @@ class BrownoutLadder:
             "calm": self._calm,
             "escalate_after": self.escalate_after,
             "recover_after": self.recover_after,
+            "gated": self.escalation_gate is not None,
+            "gate_held": self._gate_held,
         }
 
 

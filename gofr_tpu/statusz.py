@@ -28,6 +28,10 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
             "version": container.app_version,
         },
     }
+    # debug-surface index (ISSUE 18): every enabled /debug/* page with a
+    # one-line description lives behind this link
+    if getattr(app, "_debug_surfaces", None):
+        status["app"]["debug_index"] = "/debug/"
 
     # SLO & watchdog view (ISSUE 2): windowed goodput and the degradation
     # state machine next to the queues they explain
@@ -37,6 +41,22 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
     watchdog = getattr(container, "watchdog", None)
     if watchdog is not None:
         status["watchdog"] = watchdog.statusz()
+
+    # error-budget burn plane (ISSUE 18): per-(model, class) burn rates
+    # and budget remaining — the full view (plus worst offenders) lives
+    # on /debug/sloz
+    plane = getattr(container, "slo_budget", None)
+    if plane is not None:
+        try:
+            status["slo_budget"] = plane.statusz()
+        except Exception as exc:   # a budget bug must not 500 statusz
+            status["slo_budget"] = {"error": repr(exc)}
+    offenders = getattr(container, "offenders", None)
+    if offenders is not None:
+        try:
+            status["worst_offenders"] = offenders.snapshot(limit=8)
+        except Exception as exc:
+            status["worst_offenders"] = {"error": repr(exc)}
 
     # continuous telemetry plane (ISSUE 16): compact sparkline view of
     # the time-series store plus any active anomalies — the offending

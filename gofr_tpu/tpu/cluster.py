@@ -279,6 +279,15 @@ class InProcTransport:
         slo = getattr(engine, "slo", None)
         if slo is not None:
             out["slo"] = slo.snapshot()
+        # error-budget burn rollup (ISSUE 18): App.start attaches the
+        # ErrorBudgetPlane here the same way it attaches telemetry, so
+        # the fleet view lifts burn rates without a second HTTP hop
+        plane = getattr(engine, "slo_budget", None)
+        if plane is not None:
+            try:
+                out["slo_budget"] = plane.statusz()
+            except Exception:   # a budget bug must not blind the probe
+                pass
         digest_fn = getattr(engine, "prefix_digest", None)
         if digest_fn is not None:
             # fleet routing (tpu/fleet.py): compact resident-prefix

@@ -148,6 +148,10 @@ class FlightRecorder:
         # terminal status passes through, so a TrafficRecorder attached
         # here sees the finish reason for free
         self.workload: Optional[Any] = None
+        # root-cause diagnosis (ISSUE 18): a WorstOffenders ring attached
+        # here sees every terminal record and keeps the top-K slowest per
+        # window with their diagnosis computed at finish time
+        self.offenders: Optional[Any] = None
         self._lock = threading.Lock()
         self._inflight: Dict[int, RequestRecord] = {}
         self._completed: "deque[RequestRecord]" = deque(maxlen=capacity)
@@ -172,6 +176,9 @@ class FlightRecorder:
         workload = self.workload
         if workload is not None:
             workload.finish(record)
+        offenders = self.offenders
+        if offenders is not None:
+            offenders.offer(record)
 
     def record_step(self, model: str, bucket: int, batch: int,
                     phases: Dict[str, float]) -> None:

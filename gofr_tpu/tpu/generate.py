@@ -1768,7 +1768,7 @@ class GenerationEngine:
         self.recorder.finish(flight.record, "expired")
         self._shed_by_class[cls] = self._shed_by_class.get(cls, 0) + 1
         if self.slo is not None:
-            self.slo.record_outcome("expired", cls=cls)
+            self.slo.record_outcome("expired", cls=cls, model=self.model_name)
         if self.metrics is not None:
             self.metrics.increment_counter(
                 "app_tpu_sched_shed_total", model=self.model_name, cls=cls)
@@ -3160,7 +3160,8 @@ class GenerationEngine:
                     flight.qspan.finish()
                 self.recorder.finish(flight.record, "expired")
                 if self.slo is not None:
-                    self.slo.record_outcome("expired", cls=cls)
+                    self.slo.record_outcome("expired", cls=cls,
+                                            model=self.model_name)
                 if self.logger is not None:
                     self.logger.warn(
                         "engine: shed expired request before prefill "
@@ -4029,7 +4030,8 @@ class GenerationEngine:
             self.recorder.finish(flight.record, "expired")
             self._shed_by_class[cls] = self._shed_by_class.get(cls, 0) + 1
             if self.slo is not None:
-                self.slo.record_outcome("expired", cls=cls)
+                self.slo.record_outcome("expired", cls=cls,
+                                        model=self.model_name)
             if self.metrics is not None:
                 self.metrics.increment_counter(
                     "app_tpu_sched_shed_total", model=self.model_name,
@@ -4164,10 +4166,22 @@ class GenerationEngine:
                 if self.slo is not None:
                     # terminal classification: within deadline (or no
                     # deadline) → ok and its tokens count as goodput;
-                    # late → violated (work done, value lost)
+                    # late → violated (work done, value lost). A late
+                    # finish carries how late plus the trace id so the
+                    # violation histogram gains an exemplar pointing at
+                    # a /debug/whyz-able request (ISSUE 18).
+                    finished_at = time.monotonic()
+                    outcome = self.slo.classify(slot.deadline, finished_at)
+                    late_by_s = (finished_at - slot.deadline
+                                 if slot.deadline is not None
+                                 and finished_at > slot.deadline else None)
                     self.slo.record_outcome(
-                        self.slo.classify(slot.deadline),
-                        tokens=float(len(slot.tokens)), cls=slot.cls)
+                        outcome,
+                        tokens=float(len(slot.tokens)), cls=slot.cls,
+                        model=self.model_name,
+                        trace_id=(slot.record.trace_id
+                                  if slot.record is not None else None),
+                        late_by_s=late_by_s)
                 self._finish_slot(slot, "done")
                 if slot.future is not None and not slot.future.done():
                     slot.future.set_result(list(slot.tokens))
@@ -4209,6 +4223,11 @@ class GenerationEngine:
         slot.gen += 1
         slot.inflight = 0
         self._release_slot_kv(slot_idx, slot)
+        if self.slo is not None:
+            # a quarantined request is a terminal bad outcome: it must
+            # burn the error budget like any other failure (ISSUE 18)
+            self.slo.record_outcome("error", cls=slot.cls,
+                                    model=self.model_name)
         self._finish_slot(slot, "error")
         if slot.future is not None and not slot.future.done():
             slot.future.set_exception(exc)

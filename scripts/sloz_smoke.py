@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Tier-1 sloz smoke: a seeded fault burst must *burn* and be *named*.
+
+A tiny engine (forced host devices) serves real traffic while an
+``ErrorBudgetPlane`` differences the labelled ``app_tpu_slo_total``
+series through a ``TimeSeriesStore`` on a synthetic 1 Hz clock, and a
+``WorstOffenders`` ring diagnoses every finished request at finish
+time. After a healthy baseline, a seeded ``nan_logits`` fault plan
+poisons every request — each one quarantines into a labelled ``error``
+outcome — and the smoke asserts the full judgment path ISSUE 18 exists
+for:
+
+1. the fast window pair (5m / 1h) trips within ONE ``evaluate`` call
+   after the burst — no warm-up, no second counting path,
+2. the watchdog reason names the burning (class, window) and flips the
+   replica DEGRADED, and the brownout ladder's escalation gate sees the
+   fast burn and allows the climb,
+3. the worst offender in the ring is a burst casualty whose top whyz
+   verdict cites the fault-injection site by name, and
+4. ``/debug/whyz/{trace_id}`` serves that finish-time verdict from the
+   ring (``source="offender_ring"``).
+
+Prints ``sloz smoke: OK`` and exits 0, or raises with the failing
+property. Budget: a few seconds on 8 host CPU devices.
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.metrics.timeseries import TimeSeriesStore
+    from gofr_tpu.models import llama
+    from gofr_tpu.slo import (BrownoutLadder, SLOTracker, STATE_DEGRADED,
+                              Watchdog)
+    from gofr_tpu.slo_budget import ErrorBudgetPlane
+    from gofr_tpu.tpu import faults
+    from gofr_tpu.tpu.diagnose import WorstOffenders, build_window_context
+    from gofr_tpu.tpu.generate import GenerationEngine
+
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    container = new_mock_container()
+    slo = SLOTracker(metrics=container.metrics)
+    engine = GenerationEngine(cfg, params, max_slots=2, max_len=32,
+                              prompt_buckets=(8,), kv_page=4,
+                              paged_kv=True, prefix_cache=False,
+                              model_name="llama-tiny",
+                              logger=container.logger,
+                              metrics=container.metrics,
+                              tracer=container.tracer, slo=slo)
+
+    # detector kept quiet (huge baseline requirement): this smoke is
+    # about the budget plane's own judgment, not the anomaly detector's
+    store = TimeSeriesStore(metrics=container.metrics,
+                            detector_min_baseline=100_000)
+    plane = ErrorBudgetPlane(store, container.metrics,
+                             logger=container.logger)
+    ring = WorstOffenders(
+        k=16, window_s=300.0, keep_windows=2,
+        context_fn=lambda: build_window_context(engine=engine, store=store))
+    engine.recorder.offenders = ring
+
+    clock = {"t": 0.0}
+    ladder = BrownoutLadder(escalate_after=1)
+    ladder.escalation_gate = plane.fast_burning
+    dog = Watchdog(slo, min_attainment=0.0, hysteresis=1, brownout=ladder,
+                   budget_fn=lambda: list(
+                       plane.evaluate(now=clock["t"])["reasons"]))
+
+    prompt, budget = [9, 8, 7], 4
+
+    async def run() -> None:
+        await engine.start()
+        try:
+            # healthy baseline: one ok request per synthetic second.
+            # The first request creates the labelled series; evaluate()
+            # then discovers the (model, cls) pair and registers its
+            # providers before the priming sample.
+            tokens = await asyncio.wait_for(engine.generate(
+                prompt, max_new_tokens=budget), 60.0)
+            assert tokens, "baseline request produced no tokens"
+            plane.evaluate(now=clock["t"])
+            store.sample(now=clock["t"])     # counter priming sample
+            for _ in range(10):
+                await asyncio.wait_for(engine.generate(
+                    prompt, max_new_tokens=budget), 60.0)
+                clock["t"] += 1.0
+                store.sample(now=clock["t"])
+            healthy = plane.evaluate(now=clock["t"])
+            assert healthy["reasons"] == [], \
+                f"healthy baseline burned budget: {healthy['reasons']}"
+            assert healthy["budgets"], "no (model, cls) pair discovered"
+
+            # the burst: every request hits seeded NaN logits and
+            # quarantines into a labelled error outcome at the same
+            # cadence — the plane's ONLY input is the existing counter
+            plan = faults.FaultPlan("nan_logits", seed=11)
+            faults.install(plan)
+            for _ in range(8):
+                try:
+                    await asyncio.wait_for(engine.generate(
+                        prompt, max_new_tokens=budget), 60.0)
+                except Exception:
+                    pass                     # the poison path
+                clock["t"] += 1.0
+                store.sample(now=clock["t"])
+            assert plan.fired("nan_logits") >= 1, \
+                "the armed fault never fired — the smoke proved nothing"
+
+            # (1) one evaluation after the burst: the fast pair burns
+            state = plane.evaluate(now=clock["t"])
+            (entry,) = state["budgets"]
+            assert any(b["window"] == "fast" for b in entry["burning"]), \
+                f"fast pair did not trip in one evaluation: {entry}"
+            assert entry["budget_remaining"] < 1.0, entry
+
+            # (2) the watchdog reason names the (class, window) and the
+            # gate lets the ladder climb on the fast burn
+            assert dog.evaluate() == STATE_DEGRADED, dog.statusz()
+            reason = " ".join(dog._last_reasons)
+            assert "error budget burn" in reason, reason
+            assert "cls=batch" in reason, reason
+            assert "window=fast" in reason, reason
+            assert ladder.level == 1, ladder.statusz()
+
+            # (3) the burst casualties sit in the offender ring with a
+            # finish-time top verdict citing the fault site by name
+            snap = ring.snapshot()
+            casualties = [e for w in snap["windows"] for e in w["entries"]
+                          if e["status"] == "error"]
+            assert casualties, f"no burst casualty in the ring: {snap}"
+            victim = max(casualties, key=lambda e: e["e2e_s"])
+            assert victim["trace_id"], victim
+            entry = ring.find(victim["trace_id"])
+            top = entry["verdicts"][0]
+            assert top["rule"] == "fault_injection", entry["verdicts"]
+            assert "nan_logits" in top["cause"], top
+
+            # (4) whyz serves the finish-time verdict from the ring
+            from types import SimpleNamespace
+
+            from gofr_tpu.whyz import build_whyz
+            app = SimpleNamespace(container=SimpleNamespace(
+                app_name="smoke", app_version="0", offenders=ring,
+                tpu=engine, telemetry=store))
+            page = build_whyz(app, victim["trace_id"])
+            assert page["source"] == "offender_ring", page
+            assert page["verdicts"][0]["rule"] == "fault_injection", page
+        finally:
+            faults.reset()
+            await engine.stop()
+
+    asyncio.run(run())
+    print("sloz smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

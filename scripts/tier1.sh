@@ -45,6 +45,10 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/ragged_attn_smoke.py || e
 # exported trace replayed twice deterministically (identical digests),
 # executable-family device seconds agree with the per-class aggregate
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/replay_smoke.py || exit 1
+# sloz smoke: seeded nan_logits fault burst on live traffic — the fast
+# burn-rate pair trips in one evaluation, the watchdog reason names the
+# (class, window), the worst-offender whyz verdict cites the fault site
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/sloz_smoke.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

@@ -1,0 +1,45 @@
+"""GT013 negative fixture: every citation names a registered signal, a
+prefix-registered f-string family, or a documented metric.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+STATIC_NAMES = ["queue_depth", "brownout_level"]
+
+
+def wire(store, engine):
+    store.register("serving_compiles", lambda: 0.0)
+    names = list(STATIC_NAMES)
+    names.extend(f"queue_{cls}" for cls in engine.classes)
+    store.register_provider(names, engine.stats)
+    bad_name = f"slo_bad_{engine.model}"
+    store.register_provider((bad_name,), engine.budget)
+
+
+def cites_registered():
+    return {"signal": "serving_compiles", "count_60s": 2}
+
+
+def cites_provider_list():
+    return [{"signal": "queue_depth"}, {"signal": "brownout_level"}]
+
+
+def cites_fstring_family(entry):
+    # prefix allowance from the f-string registrations above
+    return [dict(entry, signal="queue_batch"),
+            {"signal": "slo_bad_llama_default"}]
+
+
+def cites_documented_metric():
+    # documented in the fixture catalog (gt005_docs.md)
+    return {"signal": "app_fixture_requests_total"}
+
+
+def record_local_fact():
+    # "field" keys are record-local facts, never checked
+    return {"field": "anything_goes_here", "seconds": 1.5}
+
+
+def dynamic_citation(name, entry):
+    # non-literal signal references are skipped by design
+    return dict(entry, signal=name)

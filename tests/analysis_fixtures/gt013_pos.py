@@ -1,0 +1,29 @@
+"""GT013 positive fixture: verdict evidence citing signals that exist
+nowhere — no store registration, no documented metric.
+
+Parsed by graftcheck in tests, never imported.
+"""
+
+
+def wire(store):
+    store.register("real_signal", lambda: 1.0)
+
+
+def bad_kwarg_citation(entry):
+    # signal= kwarg naming an unregistered signal
+    return dict(entry, signal="ghost_signal")
+
+
+def bad_dict_citation():
+    # dict-literal "signal" key naming an unregistered signal
+    return {"signal": "queue_depht", "depth": 3}   # typo'd queue_depth
+
+
+def bad_metric_citation():
+    # app_-namespaced but absent from the fixture docs catalog
+    return {"signal": "app_fixture_ghost_metric", "value": 1}
+
+
+def suppressed_citation():
+    # a deliberate exception rides the pragma
+    return {"signal": "known_exception"}  # graftcheck: ignore[GT013]

@@ -400,7 +400,7 @@ def test_cli_list_rules_covers_catalog():
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
         {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007",
-         "GT008", "GT009", "GT010", "GT011", "GT012"}
+         "GT008", "GT009", "GT010", "GT011", "GT012", "GT013"}
 
 
 def test_lint_metrics_shim_still_works():
@@ -488,5 +488,38 @@ def test_gt012_repo_workload_plane_scans_clean():
     report = engine.run(
         paths=[REPO / "gofr_tpu" / "tpu" / "workload.py",
                REPO / "gofr_tpu" / "workloadz.py"],
+        rules=rules, baseline={})
+    assert report.new_findings == []
+
+
+# -- GT013 watchdog-signal-drift ---------------------------------------------
+
+def test_gt013_positive_flags_unknown_signal_citations():
+    report = scan("gt013_pos.py", "GT013", docs_catalog=FIXTURE_DOCS)
+    got = keys(report)
+    assert "unknown signal 'ghost_signal'" in got       # signal= kwarg
+    assert "unknown signal 'queue_depht'" in got        # dict-literal typo
+    assert "unknown signal 'app_fixture_ghost_metric'" in got
+    assert all(f.rule == "GT013" and f.severity == "error"
+               for f in report.new_findings)
+    # the pragma'd deliberate exception is suppressed, not reported
+    assert "unknown signal 'known_exception'" not in got
+    assert report.suppressed >= 1
+
+
+def test_gt013_negative_registered_and_documented_names_are_clean():
+    report = scan("gt013_neg.py", "GT013", docs_catalog=FIXTURE_DOCS)
+    assert report.new_findings == []
+    assert report.exit_code == 0
+
+
+def test_gt013_repo_diagnosis_plane_scans_clean():
+    # the real rule table + burn plane must cite only live signal
+    # names; the timeseries module supplies the registrations
+    rules = default_rules(select=["GT013"])
+    report = engine.run(
+        paths=[REPO / "gofr_tpu" / "tpu" / "diagnose.py",
+               REPO / "gofr_tpu" / "slo_budget.py",
+               REPO / "gofr_tpu" / "metrics" / "timeseries.py"],
         rules=rules, baseline={})
     assert report.new_findings == []

@@ -1,0 +1,363 @@
+"""ISSUE 18: error-budget burn-rate plane + whyz diagnosis.
+
+Pins the acceptance properties: burn math stays sane across counter
+resets and store tier hops (10s→60s must not manufacture a spike), a
+pair fires only when BOTH its windows burn, the watchdog reason names
+the burning (class, window), the brownout escalation gate holds rungs
+without a fast burn, the diagnoser is byte-deterministic with a
+dominant phase that agrees with the phase sums, the worst-offender
+ring is bounded by construction, and the /debug/ index + sloz/whyz
+endpoints serve.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import time
+
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.metrics.timeseries import TimeSeriesStore
+from gofr_tpu.slo import (BrownoutLadder, SLOTracker, STATE_DEGRADED,
+                          Watchdog)
+from gofr_tpu.slo_budget import ErrorBudgetPlane
+from gofr_tpu.tpu.diagnose import WorstOffenders, diagnose
+from gofr_tpu.tpu.flightrecorder import RequestRecord
+from tests.util import http_request, make_app, run, serving
+
+
+def _plane(**kwargs):
+    """A plane over a quiet store and a fresh metrics manager."""
+    container = new_mock_container()
+    metrics = container.metrics
+    store = TimeSeriesStore(detector_min_baseline=100_000)
+    slo = SLOTracker(metrics=metrics)
+    plane = ErrorBudgetPlane(store, metrics, **kwargs)
+    return metrics, store, slo, plane
+
+
+def _seed(slo, plane, store, t0, cls="interactive", model="llama"):
+    """Create the labelled series, register its providers, and take the
+    baseline sample (the store's counter kind skips the first one)."""
+    slo.record_outcome("ok", cls=cls, model=model)
+    plane.evaluate(now=t0)
+    store.sample(now=t0)
+
+
+# -- burn math ----------------------------------------------------------------
+
+def test_sustained_violations_trip_fast_pair():
+    metrics, store, slo, plane = _plane()
+    t0 = 5_000.0
+    _seed(slo, plane, store, t0)
+    for i in range(1, 31):
+        slo.record_outcome("violated", cls="interactive", model="llama")
+        store.sample(now=t0 + i)
+    state = plane.evaluate(now=t0 + 30)
+    (entry,) = state["budgets"]
+    assert entry["model"] == "llama" and entry["cls"] == "interactive"
+    # 100% bad against a 1% budget: ~100x burn on every filled window
+    assert entry["burn"]["5m"] > plane.fast_threshold
+    assert any(b["window"] == "fast" for b in entry["burning"])
+    reason = " ".join(state["reasons"])
+    assert "cls=interactive" in reason
+    assert "model=llama" in reason
+    assert "window=fast" in reason
+    # gauges refreshed on the same evaluation path
+    snap = metrics.snapshot()
+    assert snap["app_tpu_slo_burn_rate"].series
+    assert snap["app_tpu_slo_budget_remaining"].series
+    assert entry["budget_remaining"] < 1.0
+
+
+def test_fast_pair_needs_both_windows():
+    _, store, slo, plane = _plane()
+    t0 = 80_000.0
+    _seed(slo, plane, store, t0)
+    t = t0
+    # one hour of healthy traffic: 1 ok per 10s
+    for _ in range(360):
+        t += 10.0
+        slo.record_outcome("ok", cls="interactive", model="llama")
+        store.sample(now=t)
+    # a 30s burst of pure violations at 10x the healthy rate: the 5m
+    # window burns hot, but the 1h window remembers the clean hour
+    for _ in range(3):
+        t += 10.0
+        for _ in range(10):
+            slo.record_outcome("violated", cls="interactive", model="llama")
+        store.sample(now=t)
+    entry = plane.evaluate(now=t)["budgets"][0]
+    assert entry["burn"]["5m"] > plane.fast_threshold
+    assert entry["burn"]["1h"] < plane.fast_threshold
+    assert not any(b["window"] == "fast" for b in entry["burning"])
+    assert plane.fast_burning() is False
+    # sustain the burst for 5 more minutes: the long window catches up
+    for _ in range(30):
+        t += 10.0
+        for _ in range(10):
+            slo.record_outcome("violated", cls="interactive", model="llama")
+        store.sample(now=t)
+    state = plane.evaluate(now=t)
+    entry = state["budgets"][0]
+    assert any(b["window"] == "fast" for b in entry["burning"])
+    assert plane.fast_burning() is True
+    assert any("window=fast" in r for r in state["reasons"])
+
+
+def test_counter_reset_clamps_burn():
+    _, store, slo, plane = _plane()
+    t0 = 9_000.0
+    _seed(slo, plane, store, t0)
+    for i in range(1, 21):
+        slo.record_outcome("violated", cls="interactive", model="llama")
+        store.sample(now=t0 + i)
+    assert plane.evaluate(now=t0 + 20)["budgets"][0]["burn"]["5m"] > 0
+    # process restart: the source counter restarts near zero. The
+    # store's reset clamp must absorb the negative diff — never a
+    # negative rate, never a manufactured burn spike.
+    plane.metrics = new_mock_container().metrics
+    restarted = SLOTracker(metrics=plane.metrics)
+    restarted.record_outcome("ok", cls="interactive", model="llama")
+    store.sample(now=t0 + 21)
+    for i in range(22, 42):
+        restarted.record_outcome("ok", cls="interactive", model="llama")
+        store.sample(now=t0 + i)
+    (entry,) = plane.evaluate(now=t0 + 41)["budgets"]
+    for burn in entry["burn"].values():
+        assert burn is None or burn >= 0.0
+    for frac in entry["bad_fraction"].values():
+        assert frac is None or 0.0 <= frac <= 1.0
+    # post-reset ok-only traffic dilutes the window, it does not explode
+    assert entry["bad_fraction"]["5m"] < 1.0
+
+
+def test_tier_hop_does_not_manufacture_burn_spike():
+    _, store, slo, plane = _plane()
+    t0 = 50_000.0
+    _seed(slo, plane, store, t0)
+    t = t0
+    # steady 10% violation rate for >1h: the 5m window reads the 1s
+    # tier, 1h the 10s tier, 4h the 60s tier — same samples, coarser
+    # buckets, so the burn must agree across every tier hop
+    for _ in range(380):
+        t += 10.0
+        slo.record_outcome("violated", cls="interactive", model="llama")
+        for _ in range(9):
+            slo.record_outcome("ok", cls="interactive", model="llama")
+        store.sample(now=t)
+    entry = plane.evaluate(now=t)["budgets"][0]
+    burns = entry["burn"]
+    assert None not in (burns["5m"], burns["1h"], burns["4h"])
+    assert burns["5m"] == pytest.approx(burns["1h"], rel=0.05)
+    assert burns["1h"] == pytest.approx(burns["4h"], rel=0.05)
+    # a steady 10x burn is a slow drain, not a fast page: only the
+    # slow pair (threshold 6x) fires, never the fast pair (14.4x)
+    windows = sorted(b["window"] for b in entry["burning"])
+    assert windows == ["slow"]
+
+
+def test_objective_override_scales_budget():
+    _, store, slo, plane = _plane(
+        objective_pct=99.0,
+        objective_override=lambda cls: 90.0 if cls == "batch" else None)
+    t0 = 30_000.0
+    slo.record_outcome("ok", cls="interactive", model="m")
+    slo.record_outcome("ok", cls="batch", model="m")
+    plane.evaluate(now=t0)
+    store.sample(now=t0)
+    for i in range(1, 21):
+        slo.record_outcome("violated", cls="interactive", model="m")
+        slo.record_outcome("violated", cls="batch", model="m")
+        store.sample(now=t0 + i)
+    state = plane.evaluate(now=t0 + 20)
+    by_cls = {entry["cls"]: entry for entry in state["budgets"]}
+    assert by_cls["batch"]["objective_pct"] == 90.0
+    assert by_cls["interactive"]["objective_pct"] == 99.0
+    # identical bad fraction, 10x wider budget => 10x lower burn
+    assert by_cls["interactive"]["burn"]["5m"] == pytest.approx(
+        10.0 * by_cls["batch"]["burn"]["5m"], rel=0.01)
+
+
+# -- watchdog + brownout wiring ----------------------------------------------
+
+def test_watchdog_reason_names_class_and_window():
+    _, store, slo, plane = _plane()
+    # the watchdog's budget_fn evaluates against the real clock, so
+    # stamp the samples into the recent real-monotonic past
+    base = time.monotonic() - 40.0
+    _seed(slo, plane, store, base)
+    for i in range(1, 31):
+        slo.record_outcome("violated", cls="interactive", model="llama")
+        store.sample(now=base + i)
+    ladder = BrownoutLadder(escalate_after=1)
+    ladder.escalation_gate = plane.fast_burning
+    dog = Watchdog(slo, min_attainment=0.0, hysteresis=1,
+                   brownout=ladder, budget_fn=plane.watchdog_reasons)
+    assert dog.evaluate() == STATE_DEGRADED
+    reason = " ".join(dog._last_reasons)
+    assert "error budget burn" in reason
+    assert "cls=interactive" in reason
+    assert "model=llama" in reason
+    assert "window=fast" in reason
+    # budget_fn refreshed the plane cache right before the ladder fed,
+    # so the escalation gate saw the fast burn and allowed the climb
+    assert ladder.level == 1
+
+
+def test_brownout_gate_holds_rung_without_fast_burn():
+    ladder = BrownoutLadder(escalate_after=2, recover_after=2)
+    gate = {"open": False}
+    ladder.escalation_gate = lambda: gate["open"]
+    ladder.observe(True)
+    ladder.observe(True)
+    ladder.observe(True)
+    # pressure without budget burn: the rung holds, the hold is counted
+    assert ladder.level == 0
+    assert ladder._gate_held >= 1
+    # _pressed was preserved, so one clear gate answer escalates at once
+    gate["open"] = True
+    assert ladder.observe(True) == 1
+    # descent is never gated
+    gate["open"] = False
+    ladder.observe(False)
+    ladder.observe(False)
+    assert ladder.level == 0
+
+
+# -- diagnoser ----------------------------------------------------------------
+
+def _slow_record():
+    return {
+        "trace_id": "t-123", "span_id": None, "model": "llama",
+        "status": "done", "prompt_len": 64, "cached_prefix_len": 0,
+        "pages_held": 0, "budget": 16, "tokens": 16,
+        "queue_wait_s": 2.5, "ttft_s": 2.9, "tokens_per_s": 8.0,
+        "kv_transfer_s": 0.0, "kv_transfer_bytes": 0,
+        "timing": {"enqueued_at": 100.0, "admitted_at": 102.5,
+                   "first_token_at": 102.9, "finished_at": 104.9,
+                   "duration_s": 4.9},
+    }
+
+
+def _window_context():
+    return {
+        "faults": {"nan_logits": 3},
+        "anomalies": {"queue_depth": {"direction": "up", "z": 8.1}},
+        "serving_compiles_60s": 2.0,
+        "recent_compiles": [{"model": "llama", "bucket": 8,
+                             "cause": "first", "duration_s": 0.4}],
+        "queue_depth": 7,
+        "admission_depths": {"batch": 3, "interactive": 4},
+        "brownout_level": 1,
+        "quarantined": {"nan_logits": 2},
+    }
+
+
+def test_diagnose_byte_identical():
+    first = json.dumps(
+        diagnose(copy.deepcopy(_slow_record()),
+                 copy.deepcopy(_window_context())), sort_keys=True)
+    second = json.dumps(
+        diagnose(copy.deepcopy(_slow_record()),
+                 copy.deepcopy(_window_context())), sort_keys=True)
+    assert first == second
+    verdicts = diagnose(_slow_record(), _window_context())
+    assert [v["rank"] for v in verdicts] == \
+        list(range(1, len(verdicts) + 1))
+    confidences = [v["confidence"] for v in verdicts]
+    assert confidences == sorted(confidences, reverse=True)
+
+
+def test_diagnose_dominant_agrees_with_phase_sums():
+    verdicts = diagnose(_slow_record(), _window_context())
+    top = verdicts[0]
+    # queue.wait (2.5s) dominates prefill (0.4s) and decode (2.0s)
+    assert top["rule"] == "admission_backlog"
+    assert top["dominant_phase"] == "queue.wait"
+    phases = top["phase_s"]
+    assert top["dominant_phase"] == \
+        max(sorted(phases.items()), key=lambda item: item[1])[0]
+    assert top["e2e_s"] == pytest.approx(4.9)
+    assert sum(phases.values()) == pytest.approx(top["e2e_s"])
+    # without an explicit duration, e2e falls back to the phase sum
+    record = _slow_record()
+    record["timing"]["duration_s"] = None
+    fallback = diagnose(record, {})
+    assert fallback[0]["e2e_s"] == pytest.approx(
+        sum(fallback[0]["phase_s"].values()))
+
+
+# -- worst-offender ring ------------------------------------------------------
+
+def _finished(trace, t0, e2e):
+    record = RequestRecord(model="llama", prompt_len=4, trace_id=trace)
+    record.enqueued_at = t0
+    record.admitted_at = t0 + 0.5 * e2e
+    record.first_token_at = t0 + 0.8 * e2e
+    record.finished_at = t0 + e2e
+    record.status = "done"
+    record.tokens = 3
+    return record
+
+
+def test_worst_offenders_ring_bounded():
+    ring = WorstOffenders(k=2, window_s=10.0, keep_windows=2,
+                          context_fn=lambda: {"queue_depth": 1})
+    for i, e2e in enumerate((1.0, 5.0, 2.0, 4.0, 3.0)):
+        ring.offer(_finished(f"w1-{i}", 1000.0, e2e))
+    snap = ring.snapshot()
+    assert len(snap["windows"]) == 1
+    ids = [e["trace_id"] for e in snap["windows"][0]["entries"]]
+    assert ids == ["w1-1", "w1-3"]   # top-2 by e2e, trimmed on insert
+    assert snap["windows"][0]["entries"][0]["top_verdict"]
+    # two more windows: the deque keeps only the newest keep_windows
+    ring.offer(_finished("w2-0", 1010.0, 6.0))
+    ring.offer(_finished("w3-0", 1020.0, 2.0))
+    snap = ring.snapshot()
+    assert len(snap["windows"]) == 2
+    assert sum(len(w["entries"]) for w in snap["windows"]) <= \
+        ring.k * snap["keep_windows"]
+    assert ring.find("w1-1") is None          # rotated out with its window
+    assert ring.worst()["trace_id"] == "w2-0"
+    entry = ring.find("w3-0")
+    assert entry is not None
+    assert entry["verdicts"][0]["rank"] == 1
+    assert entry["record"]["timing"]["duration_s"] == pytest.approx(2.0)
+
+
+# -- HTTP surfaces ------------------------------------------------------------
+
+def test_debug_index_and_endpoints():
+    async def main():
+        app = make_app()
+        app.enable_statusz()
+        app.enable_sloz()
+        app.enable_whyz()
+        async with serving(app) as port:
+            result = await http_request(port, "GET", "/debug/")
+            assert result.status == 200
+            index = result.json()["data"]
+            assert "/debug/statusz" in index
+            assert "/debug/sloz" in index
+            assert "/debug/whyz/{trace_id}" in index
+            result = await http_request(port, "GET", "/debug/sloz")
+            assert result.status == 200
+            page = result.json()["data"]
+            assert "slo_budget" in page
+            assert "watchdog" in page
+            assert "worst_offenders" in page
+            result = await http_request(port, "GET", "/debug/whyz")
+            assert result.status == 200
+            assert "usage" in result.json()["data"]
+            result = await http_request(port, "GET", "/debug/whyz/nope")
+            assert result.status == 200
+            body = result.json()["data"]
+            assert body["verdicts"] == []
+            assert body["error"]
+            result = await http_request(port, "GET", "/debug/statusz")
+            page = result.json()["data"]
+            assert page["app"]["debug_index"] == "/debug/"
+    run(main())
