@@ -237,6 +237,7 @@ def build_app():
     app.enable_workloadz()      # traffic-shape ring + trace export + roofline
     app.enable_sloz()           # error-budget burn rates + worst offenders
     app.enable_whyz()           # per-trace slow-request root-cause verdicts
+    app.enable_tunez()          # operating point + auto-tuner candidate ledger
     app.enable_profiler()       # duration-capped on-demand XLA captures
 
     @app.on_startup

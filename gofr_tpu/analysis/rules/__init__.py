@@ -21,6 +21,7 @@ from gofr_tpu.analysis.rules.gt011_telemetry import \
 from gofr_tpu.analysis.rules.gt012_workload import WorkloadContentLeakRule
 from gofr_tpu.analysis.rules.gt013_watchdog_reasons import \
     WatchdogReasonDriftRule
+from gofr_tpu.analysis.rules.gt014_knobs import ServingKnobMutationRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -36,6 +37,7 @@ ALL_RULES = (
     UnboundedTelemetryBufferRule,
     WorkloadContentLeakRule,
     WatchdogReasonDriftRule,
+    ServingKnobMutationRule,
 )
 
 

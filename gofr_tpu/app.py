@@ -304,6 +304,14 @@ class App:
             prefix, "error-budget burn rates per (model, SLO class) and "
                     "the worst-offender ring")
 
+    # -- auto-tuner decision plane tunez (ISSUE 19; tunez.py) ---------------
+    def enable_tunez(self, prefix: str = "/debug/tunez") -> None:
+        from gofr_tpu.tunez import enable_tunez
+        enable_tunez(self, prefix)
+        self._note_debug_surface(
+            prefix, "live operating point with provenance, candidate "
+                    "ledger, and auto-tuner guard states")
+
     # -- slow-request diagnosis whyz (ISSUE 18; whyz.py) --------------------
     def enable_whyz(self, prefix: str = "/debug/whyz") -> None:
         from gofr_tpu.whyz import enable_whyz
@@ -570,6 +578,25 @@ class App:
             self.container.tpu.slo_budget = self.container.slo_budget
         if self.container.watchdog is not None:
             self.container.watchdog.start()
+
+        # online operating-point auto-tuner (ISSUE 19): cron-driven
+        # controller that retunes the engine's serving knobs from live
+        # signals + shadow replay of the recorded workload. Opt-in
+        # (AUTOTUNE_ENABLED, default off) and built after the budget
+        # plane so its fast-burn standoff gate can be wired.
+        from gofr_tpu.tpu.autotune import new_autotuner
+        self.container.autotune = new_autotuner(
+            self.config, self.container.tpu,
+            workload=self.container.workload,
+            telemetry=self.container.telemetry,
+            metrics=self.container.metrics, logger=self.logger,
+            fast_burn_fn=(self.container.slo_budget.fast_burning
+                          if self.container.slo_budget is not None
+                          else None))
+        if self.container.autotune is not None:
+            self.add_cron_job(
+                self.config.get("AUTOTUNE_CRON") or "* * * * *",
+                "autotune", self.container.autotune)
 
         # worst-offender ring (ISSUE 18): top-K slowest requests per
         # window, diagnosed at finish time against the live window

@@ -75,6 +75,10 @@ class Container:
         # window with finish-time diagnoses, created by App.start
         # (WHYZ_*); /debug/whyz and /debug/sloz read it here
         self.offenders = None
+        # online operating-point auto-tuner (ISSUE 19): the cron-driven
+        # controller, created by App.start (AUTOTUNE_*, opt-in);
+        # /debug/tunez and the statusz autotune section read it here
+        self.autotune = None
 
         self._start_time = time.time()
 
@@ -398,6 +402,29 @@ class Container:
         metrics.new_gauge(
             "app_tpu_fleet_decode_replicas",
             "READY decode-serving replicas the autoscaler last observed")
+        # online operating-point auto-tuner (ISSUE 19): guarded cron
+        # controller retuning serving knobs from shadow-replay scores
+        metrics.new_counter(
+            "app_tpu_autotune_total",
+            "auto-tuner decisions by result (applied|rejected|"
+            "rolled_back|hold|proposed|probation|no_trace|cooldown|"
+            "compile_guard|overlap|refused_brownout|refused_fast_burn|"
+            "rollback_blocked|probation_ok)")
+        metrics.new_gauge(
+            "app_tpu_autotune_score",
+            "shadow-replay score of the last APPLIED operating point "
+            "(deterministic goodput-per-cost proxy over the recorded "
+            "trace)")
+        metrics.new_gauge(
+            "app_tpu_autotune_generation",
+            "operating-point generation counter on the engine — bumps "
+            "on every guarded apply, including rollbacks")
+        metrics.new_counter(
+            "app_tpu_engine_compiles_total",
+            "engine-side executable compiles per (cls, model): cls is "
+            "warmup (charged inside warmup/prewarm) or serving (a "
+            "jit-cache miss on the hot path — the recompile-storm "
+            "signal the auto-tuner guard reads)")
         # chaos plane catalog (ISSUE 14): seeded fault injection and the
         # recovery machinery it exercises — retries, hedges, circuit
         # trials, resumable decode, quarantine, and the brownout ladder

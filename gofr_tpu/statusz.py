@@ -58,6 +58,16 @@ def build_status(app, recent: int = 32) -> Dict[str, Any]:
         except Exception as exc:
             status["worst_offenders"] = {"error": repr(exc)}
 
+    # online auto-tuner (ISSUE 19): live operating point + guard state
+    # and the last few decisions — the full candidate ledger lives on
+    # /debug/tunez
+    autotune = getattr(container, "autotune", None)
+    if autotune is not None:
+        try:
+            status["autotune"] = autotune.status()
+        except Exception as exc:   # a tuner bug must not 500 statusz
+            status["autotune"] = {"error": repr(exc)}
+
     # continuous telemetry plane (ISSUE 16): compact sparkline view of
     # the time-series store plus any active anomalies — the offending
     # signal shows up both here and in the watchdog's last_reasons; the

@@ -93,6 +93,34 @@ class DynamicBatcher:
                                             self._flush, name)
         return await future
 
+    def apply_operating_point(self, max_batch: Optional[int] = None,
+                              max_delay_ms: Optional[float] = None
+                              ) -> Dict[str, Any]:
+        """Guarded retune of the batcher's coalescing knobs — the
+        sanctioned mutation path (graftcheck GT014 flags direct writes
+        from outside). Validate-then-swap with no awaits, so an enqueue
+        observes either the old knobs or the new ones; queued examples
+        and armed timers are untouched (the next flush decision feels
+        the change). Returns the applied values."""
+        if max_batch is not None:
+            max_batch = int(max_batch)
+            if max_batch < 1:
+                raise ValueError(
+                    f"apply_operating_point: max_batch {max_batch} "
+                    f"must be >= 1")
+        if max_delay_ms is not None:
+            max_delay_ms = float(max_delay_ms)
+            if max_delay_ms < 0:
+                raise ValueError(
+                    f"apply_operating_point: max_delay_ms "
+                    f"{max_delay_ms} must be >= 0")
+        if max_batch is not None:
+            self.max_batch = max_batch
+        if max_delay_ms is not None:
+            self.max_delay = max_delay_ms / 1000.0
+        return {"max_batch": self.max_batch,
+                "max_delay_ms": self.max_delay * 1000.0}
+
     def queue_depths(self) -> Dict[str, int]:
         """Examples currently waiting for a flush, per model — the batcher
         half of ``/debug/statusz``'s queue-depth view."""

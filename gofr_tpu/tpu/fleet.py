@@ -789,6 +789,98 @@ class FleetRouter(DisaggRouter):
         }
 
 
+class GuardedActuator:
+    """The guard stack every actuating control loop shares (ISSUE 19).
+
+    Factored out of :class:`Autoscaler` so the operating-point
+    auto-tuner (``tpu/autotune.py``) holds the *same* discipline a scale
+    event does — a control loop that mutates serving state earns the
+    right to act by passing four gates, not by being called:
+
+    - **single-flight** (``busy``): the cron plane spawns every firing
+      as its own task, so a firing that finds the previous step still
+      running drops itself instead of stacking probes (GT009 shape);
+    - **hysteresis** (``observe`` + ``want_up``/``want_down``): an
+      actuation needs ``up_after`` consecutive pressure readings (or
+      ``down_after`` idle ones) — a single noisy sample never moves
+      anything;
+    - **cooldown** (``refusal`` → ``"cooldown"``): at least
+      ``cooldown_s`` between events, measured from ``fired()``;
+    - **compile guard** (``refusal`` → ``"compile_guard"``): while any
+      serve-time compile landed inside ``compile_window_s`` on the
+      attached ledger (anything with ``serving_compiles(window_s)`` —
+      the executor's CompileLedger or the engine's own compile
+      accounting), the loop holds rather than piling a state change
+      onto a recompile storm.
+
+    The owner keeps its own event ring / metrics / status rendering;
+    this class owns only the decision state, so both owners' existing
+    observable behavior (fleet tests, statusz payloads) is unchanged."""
+
+    def __init__(self, up_after: int = 2, down_after: int = 3,
+                 cooldown_s: float = 60.0,
+                 compile_ledger=None, compile_window_s: float = 120.0):
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.cooldown_s = float(cooldown_s)
+        self.compile_ledger = compile_ledger
+        self.compile_window_s = float(compile_window_s)
+        self.busy = False
+        self.up_streak = 0
+        self.down_streak = 0
+        self.last_event_at: Optional[float] = None
+
+    def observe(self, pressure: bool, idle: bool) -> None:
+        """Advance the hysteresis streaks with one reading. A reading
+        that is neither pressure nor idle resets both (mixed signals
+        must not creep toward an actuation)."""
+        if pressure:
+            self.up_streak += 1
+            self.down_streak = 0
+        elif idle:
+            self.down_streak += 1
+            self.up_streak = 0
+        else:
+            self.up_streak = self.down_streak = 0
+
+    def want_up(self) -> bool:
+        return self.up_streak >= self.up_after
+
+    def want_down(self) -> bool:
+        return self.down_streak >= self.down_after
+
+    def refusal(self, now: Optional[float] = None) -> Optional[str]:
+        """The guard that refuses an otherwise-wanted actuation right
+        now: ``"cooldown"``, ``"compile_guard"``, or None (clear)."""
+        now = time.monotonic() if now is None else now
+        if (self.last_event_at is not None
+                and now - self.last_event_at < self.cooldown_s):
+            return "cooldown"
+        if self.compile_ledger is not None and \
+                self.compile_ledger.serving_compiles(
+                    self.compile_window_s) > 0:
+            return "compile_guard"
+        return None
+
+    def fired(self, now: Optional[float] = None,
+              direction: str = "up") -> None:
+        """Record an actuation: starts the cooldown and resets the
+        streak that earned it (the other streak is already zero)."""
+        self.last_event_at = time.monotonic() if now is None else now
+        if direction == "up":
+            self.up_streak = 0
+        else:
+            self.down_streak = 0
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "busy": self.busy,
+            "streaks": {"up": self.up_streak, "down": self.down_streak},
+            "cooldown_s": self.cooldown_s,
+            "last_event_at": self.last_event_at,
+        }
+
+
 class Autoscaler:
     """Decode-pool autoscaler, shipped as a cron handler.
 
@@ -840,16 +932,52 @@ class Autoscaler:
         self.queue_high = int(queue_high)
         self.queue_low = int(queue_low)
         self.hbm_high = float(hbm_high)
-        self.up_after = int(up_after)
-        self.down_after = int(down_after)
-        self.cooldown_s = float(cooldown_s)
-        self.compile_window_s = float(compile_window_s)
+        # the shared guard stack (single-flight, hysteresis streaks,
+        # cooldown, compile guard) — the same helper the operating-point
+        # auto-tuner actuates through (ISSUE 19)
+        self.guard = GuardedActuator(
+            up_after=up_after, down_after=down_after,
+            cooldown_s=cooldown_s, compile_ledger=compile_ledger,
+            compile_window_s=compile_window_s)
         self._signals_fn = signals_fn
-        self._busy = False
-        self._up_streak = 0
-        self._down_streak = 0
-        self._last_event_at: Optional[float] = None
         self._events: List[Dict[str, Any]] = []
+
+    # -- guard state passthrough (pre-GuardedActuator attribute surface) ----
+    @property
+    def up_after(self) -> int:
+        return self.guard.up_after
+
+    @property
+    def down_after(self) -> int:
+        return self.guard.down_after
+
+    @property
+    def cooldown_s(self) -> float:
+        return self.guard.cooldown_s
+
+    @property
+    def compile_window_s(self) -> float:
+        return self.guard.compile_window_s
+
+    @property
+    def _busy(self) -> bool:
+        return self.guard.busy
+
+    @_busy.setter
+    def _busy(self, value: bool) -> None:
+        self.guard.busy = bool(value)
+
+    @property
+    def _up_streak(self) -> int:
+        return self.guard.up_streak
+
+    @property
+    def _down_streak(self) -> int:
+        return self.guard.down_streak
+
+    @property
+    def _last_event_at(self) -> Optional[float]:
+        return self.guard.last_event_at
 
     async def __call__(self, ctx=None) -> Dict[str, Any]:
         if self._busy:
@@ -876,36 +1004,23 @@ class Autoscaler:
         idle = (signals["queue_depth"] <= self.queue_low
                 and (signals["occupancy"] is None
                      or signals["occupancy"] < self.hbm_high / 2))
-        if pressure:
-            self._up_streak += 1
-            self._down_streak = 0
-        elif idle:
-            self._down_streak += 1
-            self._up_streak = 0
-        else:
-            self._up_streak = self._down_streak = 0
-        want_up = self._up_streak >= self.up_after and n < self.max_decode
-        want_down = (self._down_streak >= self.down_after
-                     and n > self.min_decode)
+        self.guard.observe(pressure, idle)
+        want_up = self.guard.want_up() and n < self.max_decode
+        want_down = self.guard.want_down() and n > self.min_decode
         if not want_up and not want_down:
             return self._note("hold", signals)
         now = time.monotonic()
-        if (self._last_event_at is not None
-                and now - self._last_event_at < self.cooldown_s):
-            return self._note("cooldown", signals)
-        if self.compile_ledger is not None and \
-                self.compile_ledger.serving_compiles(
-                    self.compile_window_s) > 0:
-            # a serve-time compile landed recently: adding or removing a
-            # replica now would shift batch shapes while the ledger is
-            # already hot — hold until the window is quiet
-            return self._note("compile_guard", signals)
+        # cooldown, then the compile ledger: a serve-time compile landed
+        # recently → adding or removing a replica now would shift batch
+        # shapes while the ledger is already hot, so hold until quiet
+        refusal = self.guard.refusal(now)
+        if refusal is not None:
+            return self._note(refusal, signals)
         if want_up:
             result = self.scale_up()
             if asyncio.iscoroutine(result):
                 await result
-            self._last_event_at = now
-            self._up_streak = 0
+            self.guard.fired(now, "up")
             return self._note("up", signals)
         victim = self._pick_victim()
         if victim is None:
@@ -913,8 +1028,7 @@ class Autoscaler:
         result = self.scale_down(victim)
         if asyncio.iscoroutine(result):
             await result
-        self._last_event_at = now
-        self._down_streak = 0
+        self.guard.fired(now, "down")
         return self._note("down", signals, victim=victim)
 
     async def _gather(self) -> Dict[str, Any]:

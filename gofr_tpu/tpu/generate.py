@@ -664,6 +664,28 @@ class GenerationEngine:
         self.telemetry = None
         self._tick_seq = 0
         self._tick_every = 64
+        # operating-point plane (ISSUE 19): every serving knob the
+        # auto-tuner may move is mutated ONLY through
+        # apply_operating_point (graftcheck GT014). slots_cap is an
+        # admission cap below max_slots — the slot arrays and compiled
+        # executables stay sized by max_slots (not live-resizable), but
+        # admission stops claiming slots past the cap, which is the
+        # live-tunable half of the slots×K tradeoff.
+        self.slots_cap: Optional[int] = None
+        self._op_source = "seed"
+        self._op_generation = 0
+        self._op_applied_at: Optional[float] = None
+        # shape signatures (prompt_buckets, steps_per_tick) whose
+        # executables are known compiled — the seed shape is, by the
+        # warmup/lazy-compile contract that predates this plane
+        self._op_prewarmed = {(self.prompt_buckets, self.steps_per_tick)}
+        # executable-compile accounting: every jit-cache miss charges
+        # one compile as warmup-class (inside warmup()/prewarm) or
+        # serving-class (on the serving path) — the engine-side twin of
+        # the executor's CompileLedger.serving_compiles signal
+        self._warming = 0
+        self._compile_events: List[Tuple[float, str, str]] = []
+        self._compiles_by_class = {"warmup": 0, "serving": 0}
 
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
         self._insert_fns: Dict[Tuple[int, int], Any] = {}
@@ -776,6 +798,7 @@ class GenerationEngine:
 
             fn = jax.jit(prefill_batch)
             self._prefill_fns[(nb, lb)] = fn
+            self._note_compile("prefill", (nb, lb))
         return fn
 
     def _insert_fn(self, nb: int, lb: int):
@@ -805,6 +828,7 @@ class GenerationEngine:
 
             fn = jax.jit(insert, donate_argnums=(0, 5, 6, 7, 8, 9, 10))
             self._insert_fns[(nb, lb)] = fn
+            self._note_compile("insert", (nb, lb))
         return fn
 
     def _suffix_prefill_fn(self, nb: int, p: int, lb: int):
@@ -839,6 +863,7 @@ class GenerationEngine:
 
             fn = jax.jit(suffix_prefill)
             self._suffix_prefill_fns[(nb, p, lb)] = fn
+            self._note_compile("suffix_prefill", (nb, p, lb))
         return fn
 
     def _suffix_insert_fn(self, nb: int, p: int, lb: int):
@@ -879,6 +904,7 @@ class GenerationEngine:
             fn = jax.jit(insert,
                          donate_argnums=(0, 7, 8, 9, 10, 11, 12))
             self._suffix_insert_fns[(nb, p, lb)] = fn
+            self._note_compile("suffix_insert", (nb, p, lb))
         return fn
 
     def _decode_fn(self, k_steps: int, sampled: bool = False,
@@ -945,6 +971,7 @@ class GenerationEngine:
 
                 fn = jax.jit(decode_k_sampled, donate_argnums=(2, 3, 8))
             self._decode_fns[(k_steps, sampled, window)] = fn
+            self._note_compile("decode", (k_steps, sampled, window))
         return fn
 
     def _insert_paged_fn(self, nb: int, lb: int, plen: int):
@@ -989,6 +1016,7 @@ class GenerationEngine:
 
             fn = jax.jit(insert, donate_argnums=(0, 6, 7, 8, 9, 10, 11))
             self._insert_paged_fns[(nb, lb, plen)] = fn
+            self._note_compile("insert_paged", (nb, lb, plen))
         return fn
 
     def _adopt_fn(self, n_pages: int):
@@ -1019,6 +1047,7 @@ class GenerationEngine:
 
             fn = jax.jit(adopt, donate_argnums=(0, 6, 7, 8, 9, 10, 11))
             self._adopt_fns[n_pages] = fn
+            self._note_compile("adopt", n_pages)
         return fn
 
     def _decode_paged_fn(self, k_steps: int, sampled: bool = False,
@@ -1084,6 +1113,7 @@ class GenerationEngine:
 
                 fn = jax.jit(decode_k_sampled, donate_argnums=(2, 4, 9))
             self._decode_paged_fns[(k_steps, sampled, pw)] = fn
+            self._note_compile("decode_paged", (k_steps, sampled, pw))
         return fn
 
     def _prefill_bias_fn(self, nb: int, lb: int):
@@ -1109,6 +1139,7 @@ class GenerationEngine:
 
             fn = jax.jit(prefill_batch)
             self._prefill_bias_fns[(nb, lb)] = fn
+            self._note_compile("prefill_bias", (nb, lb))
         return fn
 
     def _decode_bias_fn(self, k_steps: int, sampled: bool = False,
@@ -1176,6 +1207,7 @@ class GenerationEngine:
 
                 fn = jax.jit(decode_k_sampled, donate_argnums=(2, 3, 9))
             self._decode_bias_fns[(k_steps, sampled, window)] = fn
+            self._note_compile("decode_bias", (k_steps, sampled, window))
         return fn
 
     def _decode_paged_bias_fn(self, k_steps: int, sampled: bool = False,
@@ -1241,6 +1273,7 @@ class GenerationEngine:
 
                 fn = jax.jit(decode_k_sampled, donate_argnums=(2, 4, 10))
             self._decode_paged_bias_fns[(k_steps, sampled, pw)] = fn
+            self._note_compile("decode_paged_bias", (k_steps, sampled, pw))
         return fn
 
     def _draft_prefill_fn(self, nb: int, lb: int):
@@ -1262,6 +1295,7 @@ class GenerationEngine:
 
             fn = jax.jit(draft_prefill)
             self._draft_prefill_fns[(nb, lb)] = fn
+            self._note_compile("draft_prefill", (nb, lb))
         return fn
 
     def _draft_insert_fn(self, nb: int, lb: int):
@@ -1278,6 +1312,7 @@ class GenerationEngine:
 
             fn = jax.jit(insert, donate_argnums=(0,))
             self._draft_insert_fns[(nb, lb)] = fn
+            self._note_compile("draft_insert", (nb, lb))
         return fn
 
     def _spec_fn(self, g: int, window: Optional[int] = None):
@@ -1355,6 +1390,7 @@ class GenerationEngine:
 
             fn = jax.jit(spec_tick, donate_argnums=(3, 4, 5, 10))
             self._spec_fns[(g, window)] = fn
+            self._note_compile("spec", (g, window))
         return fn
 
     def _spec_paged_fn(self, g: int, pw: int):
@@ -1421,6 +1457,7 @@ class GenerationEngine:
 
             fn = jax.jit(spec_tick, donate_argnums=(3, 4, 6, 11))
             self._spec_paged_fns[(g, pw)] = fn
+            self._note_compile("spec_paged", (g, pw))
         return fn
 
     def _table_dev(self, pw: int):
@@ -1682,12 +1719,18 @@ class GenerationEngine:
         def compile_locked():
             # warmup mutates the (possibly shared) pool leaves repeatedly;
             # hold the pool lock so a co-resident engine's traffic never
-            # interleaves with our donating warmup executions
-            if self.paged:
-                with self._pool.lock:
+            # interleaves with our donating warmup executions. The
+            # _warming flag classes every compile in here as warmup (not
+            # serving) in the engine's compile ledger.
+            self._warming += 1
+            try:
+                if self.paged:
+                    with self._pool.lock:
+                        compile_all()
+                else:
                     compile_all()
-            else:
-                compile_all()
+            finally:
+                self._warming -= 1
 
         await loop.run_in_executor(None, compile_locked)
 
@@ -2419,6 +2462,364 @@ class GenerationEngine:
         self.workload = recorder
         self.recorder.workload = recorder
 
+    # -- operating-point plane (ISSUE 19) -----------------------------------
+    def _note_compile(self, kind: str, key) -> None:
+        """Charge one executable compile (a jit-cache miss). Compiles
+        inside ``warmup()``/``prewarm_operating_point`` are warmup-class;
+        everything else is serving-class — the signal the auto-tuner's
+        compile guard and the SLO watchdog's recompile-storm check read
+        on engines that have no executor CompileLedger."""
+        cls = "warmup" if self._warming else "serving"
+        self._compiles_by_class[cls] += 1
+        self._compile_events.append(
+            (time.monotonic(), cls, f"{kind}{key}"))
+        del self._compile_events[:-256]
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_engine_compiles_total", cls=cls,
+                model=self.model_name)
+
+    def serving_compiles(self, window_s: float = 60.0,
+                         now: Optional[float] = None) -> int:
+        """Serve-time executable compiles inside the trailing window —
+        CompileLedger-compatible, so the same recompile-storm guards
+        (autoscaler, auto-tuner, watchdog) accept an engine directly."""
+        now = time.monotonic() if now is None else now
+        return sum(1 for at, cls, _ in self._compile_events
+                   if cls == "serving" and now - at <= window_s)
+
+    def operating_point(self) -> Dict[str, Any]:
+        """The live operating point with provenance: every knob the
+        auto-tuner may move, plus where the current values came from
+        (``source`` is ``seed`` until the first guarded apply)."""
+        return {
+            "prompt_buckets": list(self.prompt_buckets),
+            "steps_per_tick": self.steps_per_tick,
+            "gamma_cap": self._gamma_cap,
+            "kv_reserve": self._kv_reserve if self.paged else None,
+            "class_weights": self._pending.weights(),
+            "slots_cap": self.slots_cap,
+            "staging_depth": self._h2d.depth,
+            "max_slots": self.max_slots,
+            "source": self._op_source,
+            "generation": self._op_generation,
+            "applied_at": self._op_applied_at,
+        }
+
+    def _op_shape_sig(self, point) -> Tuple[Tuple[int, ...], int]:
+        """Normalized (prompt_buckets, steps_per_tick) signature of a
+        candidate point — the shape-changing half of the knob set, the
+        part that maps to compiled executables."""
+        buckets = getattr(point, "prompt_buckets", None)
+        buckets = (self.prompt_buckets if buckets is None
+                   else tuple(sorted({int(b) for b in buckets})))
+        k = getattr(point, "steps_per_tick", None)
+        k = self.steps_per_tick if k is None else max(1, int(k))
+        return buckets, k
+
+    async def prewarm_operating_point(self, point) -> Dict[str, Any]:
+        """Compile every executable a shape-changing operating-point
+        move needs, off the hot path, charged as warmup-class.
+
+        Unlike ``warmup()`` this is safe while serving: it never touches
+        engine state — every donated input is a freshly allocated dummy
+        of the right shape, so it runs in an executor thread while the
+        loop keeps ticking. The cost is transient memory for one dummy
+        cache (dense) or one dummy page-pool leaf set (paged) per
+        compile; on a memory-tight replica, prewarm during a quiet
+        window. New prompt buckets are warmed across the whole
+        admission-count ladder and new decode rungs across the whole
+        window/width ladder, so an applied move never compiles on the
+        serving path (the bench's zero-serve-time-compiles bar)."""
+        buckets, k = self._op_shape_sig(point)
+        bad = [b for b in buckets if b > self.max_len]
+        if bad or not buckets:
+            raise ValueError(
+                f"prewarm: prompt buckets {bad or buckets} out of range "
+                f"(max_len={self.max_len})")
+        if self.paged:
+            bad = [b for b in buckets if b % self.kv_page]
+            if bad:
+                raise ValueError(
+                    f"prewarm: prompt buckets {bad} are not multiples of "
+                    f"kv_page {self.kv_page}")
+        rungs = [1]
+        while rungs[-1] * 2 <= k:
+            rungs.append(rungs[-1] * 2)
+        jnp = self._jnp
+        loop = asyncio.get_running_loop()
+
+        def dummy_like(tree):
+            return {name: jnp.zeros(leaf.shape, leaf.dtype)
+                    for name, leaf in tree.items()}
+
+        def slot_state():
+            return (jnp.zeros((self.max_slots,), jnp.int32),   # cache_len
+                    jnp.zeros((self.max_slots,), jnp.int32),   # last_token
+                    jnp.zeros((self.max_slots,), jnp.float32),  # temps
+                    jnp.zeros((self.max_slots,), jnp.int32),   # top_ks
+                    jnp.ones((self.max_slots,), jnp.float32),  # top_ps
+                    jnp.zeros((self.max_slots, 2), jnp.uint32))
+
+        def compile_new() -> int:
+            compiled = 0
+            for lb in buckets:
+                for nb in self._n_ladder:
+                    need_prefill = (nb, lb) not in self._prefill_fns
+                    need_insert = (
+                        (nb, lb, 0) not in self._insert_paged_fns
+                        if self.paged else
+                        (nb, lb) not in self._insert_fns)
+                    if not need_prefill and not need_insert:
+                        continue
+                    toks = jnp.zeros((nb, lb), jnp.int32)
+                    lens = jnp.ones((nb,), jnp.int32)
+                    zeros_f = jnp.zeros((nb,), jnp.float32)
+                    zeros_i = jnp.zeros((nb,), jnp.int32)
+                    ones_f = jnp.ones((nb,), jnp.float32)
+                    seeds = jnp.zeros((nb,), jnp.uint32)
+                    first, small, keys = self._prefill_fn(nb, lb)(
+                        self.params, toks, lens, zeros_f, zeros_i,
+                        ones_f, seeds)
+                    compiled += 1 if need_prefill else 0
+                    if not need_insert:
+                        continue
+                    slots = jnp.full((nb,), self.max_slots, jnp.int32)
+                    (cache_len, last_token, temps, top_ks, top_ps,
+                     sample_keys) = slot_state()
+                    if self.paged:
+                        flat = jnp.full((nb * (lb // self.kv_page),),
+                                        self._pool.sentinel, jnp.int32)
+                        self._insert_paged_fn(nb, lb, 0)(
+                            dummy_like(self._pool.leaves), small, flat,
+                            slots, lens, first, cache_len, last_token,
+                            temps, top_ks, top_ps, sample_keys,
+                            zeros_f, zeros_i, ones_f, keys)
+                    else:
+                        self._insert_fn(nb, lb)(
+                            dummy_like(self.cache), small, slots, lens,
+                            first, cache_len, last_token, temps, top_ks,
+                            top_ps, sample_keys, zeros_f, zeros_i,
+                            ones_f, keys)
+                    compiled += 1
+            active = jnp.zeros((self.max_slots,), bool)
+            for rung in rungs:
+                if self.paged:
+                    widths = list(dict.fromkeys(
+                        self._pick_page_width(w)
+                        for w in self._window_ladder))
+                    for pw in widths:
+                        for sampled in (False, True):
+                            if (rung, sampled, pw) \
+                                    in self._decode_paged_fns:
+                                continue
+                            table = jnp.full(
+                                (self.max_slots, pw),
+                                self._pool.sentinel, jnp.int32)
+                            (cache_len, last_token, temps, top_ks,
+                             top_ps, sample_keys) = slot_state()
+                            fn = self._decode_paged_fn(
+                                rung, sampled=sampled, pw=pw)
+                            if sampled:
+                                fn(self.params, last_token,
+                                   dummy_like(self._pool.leaves), table,
+                                   cache_len, active, temps, top_ks,
+                                   top_ps, sample_keys)
+                            else:
+                                fn(self.params, last_token,
+                                   dummy_like(self._pool.leaves), table,
+                                   cache_len, active)
+                            compiled += 1
+                else:
+                    for window in self._window_ladder:
+                        for sampled in (False, True):
+                            if (rung, sampled, window) \
+                                    in self._decode_fns:
+                                continue
+                            (cache_len, last_token, temps, top_ks,
+                             top_ps, sample_keys) = slot_state()
+                            fn = self._decode_fn(rung, sampled=sampled,
+                                                 window=window)
+                            if sampled:
+                                fn(self.params, last_token,
+                                   dummy_like(self.cache), cache_len,
+                                   active, temps, top_ks, top_ps,
+                                   sample_keys)
+                            else:
+                                fn(self.params, last_token,
+                                   dummy_like(self.cache), cache_len,
+                                   active)
+                            compiled += 1
+            return compiled
+
+        def compile_warming() -> int:
+            self._warming += 1
+            try:
+                return compile_new()
+            finally:
+                self._warming -= 1
+
+        compiled = await loop.run_in_executor(None, compile_warming)
+        self._op_prewarmed.add((buckets, k))
+        if self.logger is not None and compiled:
+            self.logger.info(
+                "engine prewarm: compiled %d executables for operating "
+                "point (buckets=%s k=%d)", compiled, list(buckets), k)
+        return {"compiled": compiled, "prompt_buckets": list(buckets),
+                "steps_per_tick": k}
+
+    def apply_operating_point(self, point,
+                              source: str = "autotune") -> Dict[str, Any]:
+        """Atomically swap the engine's tunable operating point — the
+        ONLY sanctioned mutation path for serving knobs (graftcheck
+        GT014 flags direct writes from outside).
+
+        ``point`` duck-types the knob set (any attribute may be None /
+        absent to mean "keep the current value"): ``prompt_buckets``,
+        ``steps_per_tick``, ``gamma_cap``, ``kv_reserve``,
+        ``class_weights``, ``slots_cap``, ``staging_depth``.
+
+        Refusals (raised, never partially applied):
+
+        - a brownout is active — retuning a degraded replica fights the
+          shedding ladder;
+        - a shape-changing move (buckets / steps_per_tick) whose
+          executables were not compiled by ``prewarm_operating_point``
+          — applying it would push compiles onto the serving path;
+        - any knob value out of range.
+
+        Everything is validated first, then swapped with no awaits in
+        between, so the engine loop observes either the old point or
+        the new one. In-flight requests keep the buckets they were
+        admitted under (their executables stay cached), which is what
+        makes a non-shape knob move bit-identical for live decodes."""
+        if self._brownout > 0:
+            raise RuntimeError(
+                f"apply_operating_point refused: brownout level "
+                f"{self._brownout} active")
+        buckets, k = self._op_shape_sig(point)
+        current_sig = (self.prompt_buckets, self.steps_per_tick)
+        if not buckets:
+            raise ValueError("apply_operating_point: empty prompt buckets")
+        bad = [b for b in buckets if b > self.max_len or b < 1]
+        if bad:
+            raise ValueError(
+                f"apply_operating_point: buckets {bad} out of range "
+                f"(max_len={self.max_len})")
+        if self.paged:
+            bad = [b for b in buckets if b % self.kv_page]
+            if bad:
+                raise ValueError(
+                    f"apply_operating_point: buckets {bad} are not "
+                    f"multiples of kv_page {self.kv_page}")
+        if (buckets, k) != current_sig \
+                and (buckets, k) not in self._op_prewarmed:
+            raise RuntimeError(
+                "apply_operating_point refused: shape-changing move "
+                f"(buckets={list(buckets)} k={k}) was not prewarmed — "
+                "call prewarm_operating_point first so compiles stay "
+                "off the serving path")
+        gamma = getattr(point, "gamma_cap", None)
+        if gamma is not None and self.spec:
+            gamma = max(1, min(int(gamma), self.spec_gamma))
+        reserve = getattr(point, "kv_reserve", None)
+        if reserve is not None and self.paged:
+            reserve = int(reserve)
+            if not 0 <= reserve < self._pool.num_pages:
+                raise ValueError(
+                    f"apply_operating_point: kv_reserve {reserve} out of "
+                    f"range [0, {self._pool.num_pages})")
+        weights = getattr(point, "class_weights", None)
+        if weights:
+            weights = {str(name): float(w) for name, w in weights.items()}
+            bad_w = [name for name, w in weights.items() if w <= 0]
+            if bad_w:
+                raise ValueError(
+                    f"apply_operating_point: non-positive class weights "
+                    f"{bad_w}")
+        cap = getattr(point, "slots_cap", None)
+        if cap is not None:
+            cap = int(cap)
+            if not 1 <= cap <= self.max_slots:
+                raise ValueError(
+                    f"apply_operating_point: slots_cap {cap} out of "
+                    f"range [1, {self.max_slots}]")
+        depth = getattr(point, "staging_depth", None)
+        if depth is not None:
+            depth = max(1, int(depth))
+        # validated — swap with no awaits (atomic wrt the engine loop).
+        # The outgoing shape stays registered as prewarmed: its
+        # executables remain in the jit caches, so a rollback re-apply
+        # is always compile-free.
+        self._op_prewarmed.add(current_sig)
+        self.prompt_buckets = buckets
+        self.steps_per_tick = k
+        ladder = [1]
+        while ladder[-1] * 2 <= k:
+            ladder.append(ladder[-1] * 2)
+        self._k_ladder = ladder
+        if gamma is not None and self.spec:
+            self._gamma_cap = gamma
+        if reserve is not None and self.paged:
+            self._kv_reserve = reserve
+        if weights:
+            self.class_weights = dict(weights)
+            self._pending.set_weights(weights)
+        self.slots_cap = cap if cap is not None else self.slots_cap
+        if depth is not None:
+            self._h2d.depth = depth
+        self._op_source = str(source)
+        self._op_generation += 1
+        self._op_applied_at = time.monotonic()
+        if self.logger is not None:
+            self.logger.info(
+                "engine operating point applied (gen %d, source=%s): "
+                "buckets=%s k=%d", self._op_generation, self._op_source,
+                list(buckets), k)
+        return self.operating_point()
+
+    def shadow_clone(self, point=None) -> "GenerationEngine":
+        """A fresh engine over the SAME config and params (device
+        arrays are shared, never copied) with a candidate operating
+        point — the shadow-replay evaluation target (ISSUE 19). The
+        clone carries no metrics/telemetry/recorder wiring, so scoring
+        traffic never pollutes live observability. It allocates its own
+        KV cache (dense) or page pool (paged), which is the memory cost
+        of shadow evaluation; speculative decode and the prefix cache
+        are not cloned (the replay cost model does not score them)."""
+        buckets, k = self._op_shape_sig(point) if point is not None \
+            else (self.prompt_buckets, self.steps_per_tick)
+        weights = getattr(point, "class_weights", None) \
+            if point is not None else None
+        kwargs: Dict[str, Any] = dict(
+            max_slots=self.max_slots, max_len=self.max_len,
+            prompt_buckets=buckets, steps_per_tick=k,
+            mesh=self.mesh,
+            window_ladder=len(self._window_ladder) > 1,
+            model_module=(None if self._llama.__name__.endswith("llama")
+                          else self._llama),
+            model_name=f"{self.model_name}@shadow",
+            class_weights=dict(weights or self.class_weights),
+            coalesce_uploads=self.coalesce_uploads,
+            coalesce_stream=self.coalesce_stream)
+        if self.paged:
+            kwargs.update(paged_kv=True, kv_page=self.kv_page,
+                          kv_pages=self._pool.num_pages,
+                          ragged_attn=self.ragged_attn)
+        return GenerationEngine(self.cfg, self.params, **kwargs)
+
+    def _admit_room(self, taken: int) -> bool:
+        """True while admission may claim another slot this pass:
+        free slots remain beyond the ``taken`` already claimed, and the
+        operating point's ``slots_cap`` (when set) is not exceeded."""
+        if len(self._free) - taken <= 0:
+            return False
+        cap = self.slots_cap
+        if cap is not None and \
+                (self.max_slots - len(self._free)) + taken >= cap:
+            return False
+        return True
+
     def stats(self) -> Dict[str, Any]:
         out = {"model": self.model_name,
                "active_slots": self.active_slots,
@@ -2480,6 +2881,9 @@ class GenerationEngine:
             "served": self._pending.served(),
             "shed": dict(self._shed_by_class),
         }
+        # engine-side compile ledger (ISSUE 19): serving-class compiles
+        # are the recompile-storm signal the auto-tuner guard reads
+        out["compiles"] = dict(self._compiles_by_class)
         if self._constrained_requests or len(self.grammar_cache):
             out["constrained"] = {
                 "requests": self._constrained_requests,
@@ -2707,6 +3111,11 @@ class GenerationEngine:
             # per-executable device time vs roofline (ISSUE 17): ranked
             # top offenders — "which compiled family burns the seconds"
             "executables": self.exec_ledger.snapshot(limit=max_rungs * 3),
+            # the live operating point + provenance (ISSUE 19): the knobs
+            # the auto-tuner moves, and whether they came from the seed
+            # config or a guarded apply
+            "operating_point": self.operating_point(),
+            "compiles": dict(self._compiles_by_class),
         }
         if self._prefix is not None:
             # prefix reuse multiplies the prefill-executable family by the
@@ -3121,9 +3530,9 @@ class GenerationEngine:
         requests: List[Tuple] = []
         # page-deferred requests re-enter FIRST (FIFO fairness: they were
         # admitted-in-order before the pool ran short)
-        while self._overflow and self._free[len(requests):]:
+        while self._overflow and self._admit_room(len(requests)):
             requests.append(self._overflow.popleft())
-        while self._free[len(requests):] and not self._pending.empty():
+        while self._admit_room(len(requests)) and not self._pending.empty():
             requests.append(self._pending.get_nowait())
         if not requests:
             return []

@@ -210,6 +210,25 @@ class ClassQueues:
     def weights(self) -> Dict[str, float]:
         return dict(self._weights)
 
+    def set_weights(self, weights: Dict[str, float]) -> None:
+        """Atomically replace the per-class weights — the guarded
+        operating-point apply path (ISSUE 19,
+        ``GenerationEngine.apply_operating_point``). Virtual clocks and
+        backlogs are untouched, so already-queued items keep their drain
+        order and only *future* pops feel the new shares. Non-positive
+        and malformed entries are rejected loudly (unlike the startup
+        parser, a runtime retune has a caller to answer to)."""
+        cleaned: Dict[str, float] = {}
+        for name, raw in weights.items():
+            weight = float(raw)
+            if weight <= 0:
+                raise ValueError(
+                    f"class weight {name!r}={raw!r} must be > 0")
+            cleaned[str(name)] = weight
+        if not cleaned:
+            raise ValueError("set_weights: empty weight map")
+        self._weights = cleaned
+
     def drain(self) -> Iterable[Tuple[str, Any]]:
         """Remove and yield every queued ``(cls, item)`` — shutdown path."""
         for cls, queue in self._queues.items():

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Tier-1 auto-tuning smoke: detuned engine → shadow-replay apply →
+seeded-fault rollback (ISSUE 19).
+
+A tiny dense engine (forced host devices) starts on a deliberately
+detuned operating point — a single ``(64,)`` prompt bucket for traffic
+whose prompts are 3–10 tokens, so nearly every prefill token is
+padding. The smoke then asserts the closed loop end to end:
+
+1. live traffic with a ``TrafficRecorder`` attached builds the
+   evidence trace, with every executable pre-compiled by ``warmup`` so
+   the serving window stays compile-free;
+2. the :class:`AutoTuner` scores the xlaz-suggested ladder by real
+   shadow replay and applies it through the guarded path —
+   ``operating_point()`` shows the tightened ladder with
+   ``source="autotune"``, a bumped generation, and **zero**
+   serve-time compiles (prewarm charged everything as warmup-class);
+3. traffic served after the apply still triggers no serve-time compile
+   (the acceptance bar: compiles stay off the serving path);
+4. the chaos plane's ``autotune.select`` site forces the WORST
+   candidate through; the probation window sees live goodput collapse
+   and rolls back to the previous point (``source="rollback"``), with
+   both the forced apply and the rollback in the candidate ledger.
+
+Prints ``autotune smoke: OK`` and exits 0, or raises with the failing
+property. Budget: ~2 minutes on 8 host CPU devices (each candidate
+scoring pass boots a throwaway shadow engine and compiles its
+ladder's executables).
+"""
+
+import asyncio
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    import jax
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu import faults
+    from gofr_tpu.tpu.autotune import AutoTuner, FAULT_SITE_SELECT
+    from gofr_tpu.tpu.faults import FaultPlan
+    from gofr_tpu.tpu.generate import GenerationEngine
+    from gofr_tpu.tpu.workload import TrafficRecorder
+
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    container = new_mock_container()
+
+    # the detuned seed point: one oversized bucket, unfused ticks
+    engine = GenerationEngine(cfg, params, max_slots=4, max_len=64,
+                              prompt_buckets=(64,), steps_per_tick=1,
+                              logger=container.logger,
+                              metrics=container.metrics)
+    recorder = TrafficRecorder(capacity=128)
+    engine.attach_workload(recorder)
+
+    async def serve(round_tag: int) -> None:
+        prompts = [list(range(1, 4 + (i % 7))) for i in range(12)]
+        await asyncio.gather(*[
+            asyncio.wait_for(
+                engine.generate(p, max_new_tokens=3, eos_id=None), 60.0)
+            for p in prompts])
+
+    async def drive() -> None:
+        await engine.warmup(prompt_counts=(1, 2, 4))
+        await engine.start()
+        try:
+            # -- evidence: recorded traffic on the detuned point ------------
+            await serve(0)
+            assert engine.serving_compiles(window_s=3600.0) == 0, \
+                "warmup did not cover the live serving shapes"
+            seed_point = engine.operating_point()
+            assert seed_point["source"] == "seed", seed_point
+
+            goodput = {"value": 100.0}
+            tuner = AutoTuner(engine, workload=recorder,
+                              logger=container.logger,
+                              improve_after=1, cooldown_s=0.0,
+                              probation_ticks=1, min_trace_events=8,
+                              goodput_fn=lambda: goodput["value"])
+
+            # -- converge: shadow replay picks the suggested ladder ---------
+            result = await tuner()
+            assert result["result"] == "applied", tuner.ledger()[-3:]
+            assert result["score"] > result["baseline"], result
+            applied = engine.operating_point()
+            assert applied["source"] == "autotune", applied
+            assert applied["generation"] == 1, applied
+            assert tuple(applied["prompt_buckets"]) != (64,), applied
+            assert max(applied["prompt_buckets"]) < 64, applied
+
+            # keep firing until the controller stops finding wins (every
+            # remaining candidate lands below the min-gain floor)
+            for _ in range(8):
+                step = await tuner()
+                if step["result"] not in ("applied", "probation"):
+                    break
+            assert step["result"] in ("rejected", "hold"), \
+                tuner.ledger()[-3:]
+            assert tuner.status()["probation"] is None
+
+            # -- serve on the tuned point: still zero serve-time compiles ---
+            await serve(1)
+            assert engine.serving_compiles(window_s=3600.0) == 0, \
+                engine.stats()["compiles"]
+            assert engine.stats()["compiles"]["warmup"] > 0
+            tuned_point = engine.operating_point()
+
+            # -- rollback drill: force the WORST candidate through ----------
+            faults.install(FaultPlan(FAULT_SITE_SELECT))
+            try:
+                forced = await tuner()
+            finally:
+                faults.install(None)
+            assert forced["result"] == "applied" and forced["forced"], \
+                forced
+            goodput["value"] = 5.0      # live goodput collapses
+            verdict = await tuner()
+            assert verdict["result"] == "rolled_back", tuner.ledger()[-3:]
+            restored = engine.operating_point()
+            assert restored["source"] == "rollback", restored
+            assert restored["prompt_buckets"] == \
+                tuned_point["prompt_buckets"], (restored, tuned_point)
+            assert tuner.status()["rollbacks"] == 1
+
+            # the rollback re-apply was compile-free too
+            assert engine.serving_compiles(window_s=3600.0) == 0, \
+                engine.stats()["compiles"]
+            results = [event["result"] for event in tuner.ledger()]
+            assert "applied" in results and "rolled_back" in results
+        finally:
+            await engine.stop()
+
+    asyncio.run(drive())
+    print("autotune smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

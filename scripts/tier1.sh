@@ -49,6 +49,11 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/replay_smoke.py || exit 1
 # burn-rate pair trips in one evaluation, the watchdog reason names the
 # (class, window), the worst-offender whyz verdict cites the fault site
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/sloz_smoke.py || exit 1
+# autotune smoke: a detuned engine converges by shadow-replay scoring
+# (suggested ladder applied with source=autotune, zero serve-time
+# compiles before AND after), then the seeded autotune.select fault
+# forces the worst candidate and probation rolls it back
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/autotune_smoke.py || exit 1
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log

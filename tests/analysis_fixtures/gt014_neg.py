@@ -1,0 +1,47 @@
+"""GT014 negative fixture: serving-knob changes that stay inside the
+guarded funnel — the apply paths themselves, self-writes inside the
+owning class, constructors wiring the seed point, and callers routing
+through apply_operating_point()."""
+
+
+class MiniEngine:
+    def __init__(self, steps_per_tick=1):
+        # constructors wire the seed operating point
+        self.steps_per_tick = steps_per_tick
+        self.prompt_buckets = (16, 64)
+        self.slots_cap = None
+        self.class_weights = {"batch": 1.0}
+
+    def apply_operating_point(self, point):
+        # the sanctioned apply path validates then swaps its own state
+        self.steps_per_tick = point.steps_per_tick
+        self.prompt_buckets = point.prompt_buckets
+        self.slots_cap = point.slots_cap
+        return self.steps_per_tick
+
+    def _retune(self, k):
+        # self-writes inside the owning class are the implementation,
+        # not a bypass
+        self.steps_per_tick = max(1, int(k))
+
+
+class MiniQueues:
+    def __init__(self):
+        self.class_weights = {"batch": 1.0}
+
+    def set_weights(self, weights):
+        # the admission-weights apply path
+        self.class_weights = dict(weights)
+
+
+def tuned_caller(engine, point):
+    # callers route through the guarded path; reads stay free
+    observed = engine.steps_per_tick
+    engine.apply_operating_point(point)
+    return observed
+
+
+def unrelated_attrs(thing):
+    # attribute names outside the knob set are not serving knobs
+    thing.max_retries = 3
+    thing.steps_total = 9

@@ -1,0 +1,30 @@
+"""GT014 positive fixture: serving knobs written directly from outside
+the owning object's guarded apply path — every one of these bypasses
+pre-warm, brownout refusal, and the atomic swap."""
+
+
+def cron_quick_fix(engine):
+    # direct knob writes from a cron handler: the canonical bypass
+    engine.steps_per_tick = 8
+    engine.prompt_buckets = (16, 64)
+
+
+def handler_tweaks(ctx):
+    batcher = ctx.container.tpu_batcher
+    # batcher coalescing knobs are serving knobs too
+    batcher.max_batch = 64
+    batcher.max_delay = 0.01
+
+
+def creeping_writes(engine):
+    # augmented assignment is the same mutation
+    engine.slots_cap += 2
+    # subscript store mutates the knob in place
+    engine.class_weights["batch"] = 9.0
+    # one more underscore is not a laundering device
+    engine._gamma_cap = 1
+
+
+def sanctioned_forensics(engine):
+    # a deliberate, reviewed exception rides the pragma
+    engine.steps_per_tick = 1  # graftcheck: ignore[GT014]

@@ -1,0 +1,467 @@
+"""Online operating-point auto-tuner tests (ISSUE 19).
+
+Load-bearing contracts:
+- the shared GuardedActuator holds the four-gate discipline (hysteresis
+  streaks, cooldown, compile guard, single-flight busy);
+- the AutoTuner refuses to act during brownout, a fast burn window, a
+  recompile storm, inside cooldown, below the hysteresis streak, or
+  without enough recorded trace evidence — each refusal named in the
+  candidate ledger;
+- replay-scored candidate selection is deterministic (two scorings of
+  the same candidate against the same trace are identical);
+- a post-apply goodput regression rolls back to the previous point
+  automatically (``source="rollback"``), bypassing cooldown;
+- the engine's guarded apply path refuses unwarmed shape changes and
+  brownouts, and a non-shape knob move is bit-identical for decode;
+- ``slots_cap`` throttles admission without stranding requests.
+"""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import jax
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.tpu import faults
+from gofr_tpu.tpu.autotune import (AutoTuner, FAULT_SITE_SELECT,
+                                   OperatingPoint, new_autotuner)
+from gofr_tpu.tpu.faults import FaultPlan
+from gofr_tpu.tpu.fleet import GuardedActuator
+from gofr_tpu.tpu.generate import GenerationEngine
+from gofr_tpu.tpu.workload import (TrafficRecorder, load_trace,
+                                   replay_trace)
+from gofr_tpu.tpu.flightrecorder import RequestRecord
+
+
+# -- GuardedActuator ----------------------------------------------------------
+
+def test_guard_hysteresis_streaks_and_mixed_reset():
+    guard = GuardedActuator(up_after=2, down_after=3)
+    guard.observe(True, False)
+    assert not guard.want_up()
+    guard.observe(True, False)
+    assert guard.want_up() and not guard.want_down()
+    # a mixed reading resets BOTH streaks
+    guard.observe(False, False)
+    assert not guard.want_up()
+    for _ in range(3):
+        guard.observe(False, True)
+    assert guard.want_down() and not guard.want_up()
+
+
+def test_guard_cooldown_and_fired_reset():
+    guard = GuardedActuator(up_after=1, cooldown_s=60.0)
+    assert guard.refusal(now=100.0) is None
+    guard.observe(True, False)
+    guard.fired(now=100.0, direction="up")
+    assert guard.up_streak == 0            # fired resets the streak
+    assert guard.refusal(now=130.0) == "cooldown"
+    assert guard.refusal(now=161.0) is None
+
+
+def test_guard_compile_ledger_holds_actuation():
+    ledger = SimpleNamespace(serving_compiles=lambda window_s: 2)
+    guard = GuardedActuator(compile_ledger=ledger)
+    assert guard.refusal(now=0.0) == "compile_guard"
+    ledger.serving_compiles = lambda window_s: 0
+    assert guard.refusal(now=0.0) is None
+
+
+# -- controller logic over a stub engine -------------------------------------
+
+class _StubEngine:
+    """Duck-types exactly the engine surface the tuner consumes."""
+
+    def __init__(self):
+        self.prompt_buckets = (64,)
+        self.steps_per_tick = 1
+        self.max_len = 64
+        self.max_slots = 4
+        self.spec = False
+        self.paged = False
+        self.kv_page = 1
+        self._brownout = 0
+        self._generation = 0
+        self._source = "seed"
+        self.applied = []
+        self.prewarmed = []
+
+    def operating_point(self):
+        return {"prompt_buckets": list(self.prompt_buckets),
+                "steps_per_tick": self.steps_per_tick,
+                "gamma_cap": 0, "kv_reserve": None,
+                "class_weights": {"batch": 1.0}, "slots_cap": None,
+                "staging_depth": 1, "max_slots": self.max_slots,
+                "source": self._source, "generation": self._generation,
+                "applied_at": None}
+
+    def xlaz(self, **kwargs):
+        return {"models": {"prompt": {"suggested_ladder": [8, 16]}}}
+
+    async def prewarm_operating_point(self, point):
+        self.prewarmed.append(point)
+        return {"compiled": 0}
+
+    def apply_operating_point(self, point, source="autotune"):
+        if self._brownout > 0:
+            raise RuntimeError("brownout active")
+        if point.prompt_buckets is not None:
+            self.prompt_buckets = tuple(point.prompt_buckets)
+        if point.steps_per_tick is not None:
+            self.steps_per_tick = point.steps_per_tick
+        self._generation += 1
+        self._source = source
+        self.applied.append((source, point))
+        return self.operating_point()
+
+    def serving_compiles(self, window_s=60.0, now=None):
+        return 0
+
+
+def _trace(n=8):
+    events = [SimpleNamespace(prompt_len=8, output_len=4, budget=4)
+              for _ in range(n)]
+    return SimpleNamespace(events=events)
+
+
+def _tuner(engine, **kwargs):
+    kwargs.setdefault("improve_after", 1)
+    kwargs.setdefault("cooldown_s", 0.0)
+    kwargs.setdefault("min_trace_events", 1)
+    kwargs.setdefault("trace_fn", _trace)
+    # deterministic synthetic scores: the suggested ladder wins big,
+    # everything else (including the current point) scores low
+    kwargs.setdefault(
+        "score_fn",
+        lambda point, trace: 10.0
+        if point.prompt_buckets == (8, 16) else 1.0)
+    return AutoTuner(engine, **kwargs)
+
+
+def test_tuner_hysteresis_holds_until_streak():
+    engine = _StubEngine()
+    tuner = _tuner(engine, improve_after=2)
+    first = asyncio.run(tuner())
+    assert first["result"] == "hold" and first["reason"] == "hysteresis"
+    second = asyncio.run(tuner())
+    assert second["result"] == "applied"
+    assert engine.applied[-1][0] == "autotune"
+    assert engine.prompt_buckets == (8, 16)
+    # the winning candidate was pre-warmed before it was applied
+    assert engine.prewarmed and engine.prewarmed[0].prompt_buckets == (8, 16)
+
+
+def test_tuner_cooldown_refuses_second_apply():
+    engine = _StubEngine()
+    tuner = _tuner(engine, cooldown_s=3600.0, probation_ticks=0)
+    assert asyncio.run(tuner())["result"] == "applied"
+    # stub keeps suggesting a differing ladder via steps moves; the
+    # cooldown must hold the second actuation regardless
+    assert asyncio.run(tuner())["result"] == "cooldown"
+    assert len(engine.applied) == 1
+
+
+def test_tuner_refusals_brownout_fast_burn_compile_storm():
+    engine = _StubEngine()
+    engine._brownout = 2
+    tuner = _tuner(engine)
+    assert asyncio.run(tuner())["result"] == "refused_brownout"
+    engine._brownout = 0
+
+    tuner = _tuner(engine, fast_burn_fn=lambda: True)
+    assert asyncio.run(tuner())["result"] == "refused_fast_burn"
+
+    storm = SimpleNamespace(serving_compiles=lambda window_s: 3)
+    tuner = _tuner(engine, compile_source=storm)
+    assert asyncio.run(tuner())["result"] == "compile_guard"
+    assert engine.applied == []
+
+
+def test_tuner_holds_without_trace_evidence():
+    engine = _StubEngine()
+    tuner = _tuner(engine, trace_fn=lambda: _trace(0))
+    assert asyncio.run(tuner())["result"] == "no_trace"
+
+
+def test_tuner_rejects_below_min_gain():
+    engine = _StubEngine()
+    tuner = _tuner(engine, score_fn=lambda point, trace: 1.0,
+                   min_gain_pct=5.0)
+    result = asyncio.run(tuner())
+    assert result["result"] == "rejected"
+    assert "min-gain" in result["reason"]
+    assert engine.applied == []
+
+
+def test_tuner_rolls_back_on_goodput_regression():
+    engine = _StubEngine()
+    goodput = {"value": 100.0}
+    tuner = _tuner(engine, probation_ticks=3, regress_pct=10.0,
+                   goodput_fn=lambda: goodput["value"])
+    assert asyncio.run(tuner())["result"] == "applied"
+    assert engine.prompt_buckets == (8, 16)
+    # live goodput collapses inside the probation window
+    goodput["value"] = 50.0
+    result = asyncio.run(tuner())
+    assert result["result"] == "rolled_back"
+    assert engine.applied[-1][0] == "rollback"
+    assert engine.prompt_buckets == (64,)       # the pre-apply point
+    assert tuner.status()["rollbacks"] == 1
+
+
+def test_tuner_probation_closes_clean_then_counts_down():
+    engine = _StubEngine()
+    goodput = {"value": 100.0}
+    tuner = _tuner(engine, probation_ticks=2, cooldown_s=3600.0,
+                   goodput_fn=lambda: goodput["value"])
+    assert asyncio.run(tuner())["result"] == "applied"
+    assert asyncio.run(tuner())["result"] == "probation"
+    # probation closes clean, the firing continues — and lands on the
+    # cooldown the apply started
+    assert asyncio.run(tuner())["result"] == "cooldown"
+    assert len(engine.applied) == 1
+
+
+def test_tuner_rollback_blocked_by_brownout_retries():
+    engine = _StubEngine()
+    goodput = {"value": 100.0}
+    tuner = _tuner(engine, probation_ticks=3,
+                   brownout_fn=lambda: 0,       # tuner gate stays open
+                   goodput_fn=lambda: goodput["value"])
+    assert asyncio.run(tuner())["result"] == "applied"
+    goodput["value"] = 10.0
+    engine._brownout = 1                         # apply path refuses
+    assert asyncio.run(tuner())["result"] == "rollback_blocked"
+    engine._brownout = 0
+    assert asyncio.run(tuner())["result"] == "rolled_back"
+    assert engine.applied[-1][0] == "rollback"
+
+
+def test_seeded_fault_forces_worst_candidate():
+    engine = _StubEngine()
+    plan = FaultPlan(FAULT_SITE_SELECT)
+    faults.install(plan)
+    try:
+        tuner = _tuner(engine)
+        result = asyncio.run(tuner())
+    finally:
+        faults.install(None)
+    # the inverted pick applies a low-scoring candidate and skips the
+    # min-gain gate — the rollback drill's entry point
+    assert result["result"] == "applied" and result["forced"]
+    assert engine.prompt_buckets != (8, 16)
+
+
+def test_build_tunez_with_and_without_controller():
+    from gofr_tpu.tunez import build_tunez
+    engine = _StubEngine()
+    container = SimpleNamespace(app_name="t", app_version="1",
+                                autotune=None, tpu=engine)
+    app = SimpleNamespace(container=container)
+    page = build_tunez(app)
+    # without the controller the page still answers "what point is live"
+    assert page["enabled"] is False
+    assert page["operating_point"]["source"] == "seed"
+
+    tuner = _tuner(engine)
+    asyncio.run(tuner())
+    container.autotune = tuner
+    page = build_tunez(app, recent=4)
+    assert page["enabled"] is True
+    assert page["operating_point"]["source"] == "autotune"
+    assert page["guard"]["streaks"]["up"] == 0
+    assert len(page["ledger"]) <= 4
+    assert any(event["result"] == "applied"
+               for event in page["ledger"])
+
+
+def test_new_autotuner_factory_is_opt_in():
+    class _Config(dict):
+        def get(self, key, default=None):
+            return dict.get(self, key, default)
+
+        def get_bool(self, key, default=False):
+            raw = self.get(key)
+            return default if raw is None else \
+                str(raw).lower() in ("1", "true", "yes", "on")
+
+        def get_int(self, key, default=0):
+            return int(self.get(key, default))
+
+        def get_float(self, key, default=0.0):
+            return float(self.get(key, default))
+
+    engine = _StubEngine()
+    assert new_autotuner(_Config(), engine) is None     # default OFF
+    tuner = new_autotuner(_Config(AUTOTUNE_ENABLED="true"), engine)
+    assert isinstance(tuner, AutoTuner)
+    # the engine's own compile accounting is the guard's ledger
+    assert tuner.guard.compile_ledger is engine
+    assert new_autotuner(_Config(AUTOTUNE_ENABLED="true"), object()) \
+        is None                                         # no apply path
+
+
+# -- engine integration -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.config("tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _make_engine(cfg, params, **kwargs):
+    container = new_mock_container()
+    kwargs.setdefault("max_slots", 4)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("prompt_buckets", (8, 16))
+    return GenerationEngine(cfg, params, logger=container.logger,
+                            metrics=container.metrics, **kwargs)
+
+
+def test_apply_refuses_unwarmed_shape_change_then_accepts(setup):
+    cfg, params = setup
+
+    async def main():
+        engine = _make_engine(cfg, params)
+        point = OperatingPoint(prompt_buckets=(8, 32), steps_per_tick=2)
+        with pytest.raises(RuntimeError, match="not prewarmed"):
+            engine.apply_operating_point(point)
+        warm = await engine.prewarm_operating_point(point)
+        assert warm["compiled"] > 0
+        applied = engine.apply_operating_point(point)
+        assert applied["prompt_buckets"] == [8, 32]
+        assert applied["steps_per_tick"] == 2
+        assert applied["source"] == "autotune"
+        assert applied["generation"] == 1
+        # every compile was charged as warmup-class: the serving window
+        # stays empty, which is what the tuner's compile guard reads
+        assert engine.serving_compiles(window_s=3600.0) == 0
+        stats = engine.stats()
+        assert stats["compiles"]["serving"] == 0
+        assert stats["compiles"]["warmup"] == warm["compiled"]
+        assert engine.xlaz()["operating_point"]["generation"] == 1
+
+    asyncio.run(main())
+
+
+def test_apply_refuses_during_brownout(setup):
+    cfg, params = setup
+    engine = _make_engine(cfg, params)
+    engine.set_brownout(2)
+    with pytest.raises(RuntimeError, match="brownout"):
+        engine.apply_operating_point(
+            OperatingPoint(class_weights={"batch": 2.0}))
+    engine.set_brownout(0)
+
+
+def test_apply_validates_knob_ranges(setup):
+    cfg, params = setup
+    engine = _make_engine(cfg, params)
+    with pytest.raises(ValueError, match="out of range"):
+        engine.apply_operating_point(
+            OperatingPoint(prompt_buckets=(8, 4096)))
+    with pytest.raises(ValueError, match="slots_cap"):
+        engine.apply_operating_point(OperatingPoint(slots_cap=99))
+    with pytest.raises(ValueError, match="non-positive"):
+        engine.apply_operating_point(
+            OperatingPoint(class_weights={"batch": -1.0}))
+
+
+def test_non_shape_knob_move_is_bit_identical_for_decode(setup):
+    cfg, params = setup
+    prompt = list(range(1, 7))
+
+    async def main():
+        engine = _make_engine(cfg, params)
+        await engine.start()
+        try:
+            before = await engine.generate(prompt, max_new_tokens=8,
+                                           eos_id=None)
+            # weights / slots_cap / staging depth change NO compiled
+            # shape — an in-flight or repeated decode must not move
+            engine.apply_operating_point(OperatingPoint(
+                class_weights={"interactive": 8.0, "standard": 2.0,
+                               "batch": 1.0},
+                slots_cap=2, staging_depth=2))
+            after = await engine.generate(prompt, max_new_tokens=8,
+                                          eos_id=None)
+        finally:
+            await engine.stop()
+        assert before == after
+        point = engine.operating_point()
+        assert point["slots_cap"] == 2
+        assert point["class_weights"]["interactive"] == 8.0
+
+    asyncio.run(main())
+
+
+def test_slots_cap_throttles_admission_without_stranding(setup):
+    cfg, params = setup
+
+    async def main():
+        engine = _make_engine(cfg, params)
+        engine.apply_operating_point(OperatingPoint(slots_cap=1))
+        await engine.start()
+        try:
+            outs = await asyncio.wait_for(asyncio.gather(*[
+                engine.generate(list(range(1, 5)), max_new_tokens=3,
+                                eos_id=None) for _ in range(3)]), 120.0)
+        finally:
+            await engine.stop()
+        assert all(len(tokens) == 3 for tokens in outs)
+
+    asyncio.run(main())
+
+
+def _recorded_trace(model="generate", n=6):
+    rec = TrafficRecorder(capacity=64)
+    for i in range(n):
+        record = RequestRecord(model=model, prompt_len=3 + (i % 3),
+                               budget=3)
+        rec.admit(record, "standard", now=10.0 + i * 0.002)
+        record.tokens = 3
+        record.status = "done"
+        rec.finish(record)
+    return load_trace(json.dumps(rec.export_trace()))
+
+
+def test_replay_scored_selection_is_deterministic(setup):
+    """The default scoring path (shadow replay + host cost model) must
+    return the identical score for the same (point, trace) twice — the
+    property that makes candidate selection reproducible."""
+    cfg, params = setup
+    engine = _make_engine(cfg, params)
+    tuner = AutoTuner(engine, min_trace_events=1)
+    trace = _recorded_trace()
+    candidate = OperatingPoint(prompt_buckets=(8,), steps_per_tick=2)
+
+    async def score_twice():
+        one = await tuner._score_point(candidate, trace)
+        two = await tuner._score_point(candidate, trace)
+        return one, two
+
+    one, two = asyncio.run(score_twice())
+    assert one == two > 0.0
+    # and the tighter ladder must beat the detuned one on the same
+    # trace — the signal convergence rides on
+    detuned = OperatingPoint(prompt_buckets=(64,), steps_per_tick=1)
+    worse = asyncio.run(tuner._score_point(detuned, trace))
+    assert worse < one
+
+
+def test_shadow_clone_carries_candidate_point_and_no_telemetry(setup):
+    cfg, params = setup
+    engine = _make_engine(cfg, params)
+    shadow = engine.shadow_clone(
+        OperatingPoint(prompt_buckets=(8,), steps_per_tick=4))
+    assert shadow.prompt_buckets == (8,)
+    assert shadow.steps_per_tick == 4
+    assert shadow.metrics is None and shadow.workload is None
+    # params are shared, never copied: same device buffers
+    assert jax.tree_util.tree_leaves(shadow.params)[0] is \
+        jax.tree_util.tree_leaves(engine.params)[0]
+    assert shadow.model_name.endswith("@shadow")

@@ -400,7 +400,7 @@ def test_cli_list_rules_covers_catalog():
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
         {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007",
-         "GT008", "GT009", "GT010", "GT011", "GT012", "GT013"}
+         "GT008", "GT009", "GT010", "GT011", "GT012", "GT013", "GT014"}
 
 
 def test_lint_metrics_shim_still_works():
@@ -521,5 +521,43 @@ def test_gt013_repo_diagnosis_plane_scans_clean():
         paths=[REPO / "gofr_tpu" / "tpu" / "diagnose.py",
                REPO / "gofr_tpu" / "slo_budget.py",
                REPO / "gofr_tpu" / "metrics" / "timeseries.py"],
+        rules=rules, baseline={})
+    assert report.new_findings == []
+
+
+# -- GT014 serving-knob-mutation ----------------------------------------------
+
+def test_gt014_positive_flags_direct_knob_writes():
+    report = scan("gt014_pos.py", "GT014")
+    got = keys(report)
+    assert "knob write engine.steps_per_tick" in got     # cron handler
+    assert "knob write engine.prompt_buckets" in got
+    assert "knob write batcher.max_batch" in got         # batcher knobs
+    assert "knob write batcher.max_delay" in got
+    assert "knob write engine.slots_cap" in got          # augassign
+    assert "knob write engine.class_weights" in got      # subscript store
+    assert "knob write engine._gamma_cap" in got         # private twin
+    assert all(f.rule == "GT014" and f.severity == "error"
+               for f in report.new_findings)
+    # the pragma'd deliberate poke is suppressed, not reported
+    assert report.suppressed >= 1
+
+
+def test_gt014_negative_guarded_paths_are_clean():
+    report = scan("gt014_neg.py", "GT014")
+    assert report.new_findings == []
+    assert report.exit_code == 0
+
+
+def test_gt014_repo_serving_layers_scan_clean():
+    # the real engine/batcher/tuner must route every runtime knob move
+    # through the guarded apply paths they define
+    rules = default_rules(select=["GT014"])
+    report = engine.run(
+        paths=[REPO / "gofr_tpu" / "tpu" / "generate.py",
+               REPO / "gofr_tpu" / "tpu" / "batcher.py",
+               REPO / "gofr_tpu" / "tpu" / "autotune.py",
+               REPO / "gofr_tpu" / "tpu" / "sched.py",
+               REPO / "gofr_tpu" / "app.py"],
         rules=rules, baseline={})
     assert report.new_findings == []
