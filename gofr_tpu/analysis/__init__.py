@@ -23,12 +23,14 @@ Rules:
 
 from gofr_tpu.analysis.engine import (
     DEFAULT_BASELINE,
+    DEFAULT_CACHE,
     Finding,
     ModuleInfo,
     PACKAGE,
     ROOT,
     Report,
     Rule,
+    audit_pragmas,
     load_baseline,
     run,
     write_baseline,
@@ -38,12 +40,14 @@ from gofr_tpu.analysis.rules import ALL_RULES, default_rules
 __all__ = [
     "ALL_RULES",
     "DEFAULT_BASELINE",
+    "DEFAULT_CACHE",
     "Finding",
     "ModuleInfo",
     "PACKAGE",
     "ROOT",
     "Report",
     "Rule",
+    "audit_pragmas",
     "default_rules",
     "load_baseline",
     "run",

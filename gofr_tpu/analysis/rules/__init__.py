@@ -22,6 +22,10 @@ from gofr_tpu.analysis.rules.gt012_workload import WorkloadContentLeakRule
 from gofr_tpu.analysis.rules.gt013_watchdog_reasons import \
     WatchdogReasonDriftRule
 from gofr_tpu.analysis.rules.gt014_knobs import ServingKnobMutationRule
+from gofr_tpu.analysis.rules.gt015_donate import DonateUseRule
+from gofr_tpu.analysis.rules.gt016_pool_lock import PoolLockRule
+from gofr_tpu.analysis.rules.gt017_lock_across_await import \
+    LockAcrossAwaitRule
 
 ALL_RULES = (
     EventLoopBlockRule,
@@ -38,6 +42,9 @@ ALL_RULES = (
     WorkloadContentLeakRule,
     WatchdogReasonDriftRule,
     ServingKnobMutationRule,
+    DonateUseRule,
+    PoolLockRule,
+    LockAcrossAwaitRule,
 )
 
 

@@ -7,24 +7,31 @@ work. Every device wait in the serving stack is hand-offloaded via
 ``run_in_executor`` (``gofr_tpu/tpu/generate.py`` dispatch/fetch); this
 rule makes that discipline machine-checked.
 
-Detection: build the module call graph (callgraph.py), take every
-function reachable from an ``async def`` without a thread hop, and flag:
+Detection (v2, whole-program): take every function reachable from an
+``async def`` along the *project* call graph — through ``from x import
+y`` helpers, typed ``self.engine.step()`` receivers, and duck-typed
+collaborators, across any number of modules — without a thread hop, and
+flag:
 
 - ``time.sleep`` (use ``await asyncio.sleep``),
 - ``jax.block_until_ready`` / any ``.block_until_ready()`` method,
 - ``jax.device_get`` and ``np.asarray`` / ``np.array`` (device→host
   sync when handed a device value),
 - ``.item()`` (scalar device sync),
-- un-awaited ``.acquire()`` (``await lock.acquire()`` on an asyncio lock
-  is fine; a bare call is a thread-lock wait),
+- un-awaited ``.acquire()`` on a lock-named receiver (``await
+  lock.acquire()`` on an asyncio lock is fine; a bare call is a
+  thread-lock wait). The receiver's name must look like a lock
+  (``lock``/``mutex``/``sem``/``cond`` in its last segment) — the
+  staging pool's ``acquire()`` is a slab lease, not a wait,
 - ``concurrent.futures`` waits (``cf.wait``, dotted ``.result`` on the
   futures module),
 - builtin ``open()`` and ``socket.create_connection`` (sync I/O).
 
 Functions *passed* to ``run_in_executor`` / ``asyncio.to_thread`` never
-get a call edge, so offloaded work is naturally exempt. Suppress a
-deliberate host-side use with ``# graftcheck: ignore[GT001]`` plus a
-justification comment.
+get a call edge, so offloaded work is naturally exempt — even when the
+offloaded closure lives two modules away. Suppress a deliberate
+host-side use with ``# graftcheck: ignore[GT001]`` plus a justification
+comment.
 """
 
 from __future__ import annotations
@@ -32,7 +39,6 @@ from __future__ import annotations
 import ast
 from typing import Iterable, List, Optional, Tuple
 
-from gofr_tpu.analysis.callgraph import CallGraph
 from gofr_tpu.analysis.engine import Finding, ModuleInfo, Rule
 
 # fully-dotted callables that block the calling thread
@@ -60,21 +66,24 @@ class EventLoopBlockRule(Rule):
     title = "event-loop-block"
     severity = "error"
 
-    def check_module(self, module: ModuleInfo) -> Iterable[Finding]:
-        graph = CallGraph(module)
-        chains = graph.loop_reachable()
+    def check_project(self, project) -> Iterable[Finding]:
+        chains = project.reachable(project.async_roots())
         findings: List[Finding] = []
-        for qualname, chain in chains.items():
-            fn = graph.functions[qualname]
-            for node in graph.body_nodes(fn):
+        for ref, chain in chains.items():
+            module = project.module_of(ref)
+            qualname = ref[1]
+            for node in project.body_nodes(ref):
                 if not isinstance(node, ast.Call):
                     continue
                 hit = self._blocking(module, node)
                 if hit is None:
                     continue
                 label, why = hit
-                via = (" via " + " -> ".join(chain[1:])
-                       if len(chain) > 1 else "")
+                root = project.display(chain[0], module.relpath)
+                via = (" via " + " -> ".join(
+                    project.display(r, module.relpath)
+                    for r in chain[1:])
+                    if len(chain) > 1 else "")
                 findings.append(Finding(
                     rule=self.rule_id,
                     path=module.relpath,
@@ -82,7 +91,7 @@ class EventLoopBlockRule(Rule):
                     message=(
                         f"event-loop-block: {label} inside '{qualname}' "
                         f"runs on the event loop (async root "
-                        f"'{chain[0]}'{via}) — {why}; offload with "
+                        f"'{root}'{via}) — {why}; offload with "
                         f"run_in_executor/asyncio.to_thread"),
                     severity=self.severity,
                     key=f"{label} in {qualname}",
@@ -102,9 +111,23 @@ class EventLoopBlockRule(Rule):
             if func.attr in BLOCKING_METHODS:
                 return f".{func.attr}()", BLOCKING_METHODS[func.attr]
             if func.attr == "acquire" and \
-                    not isinstance(module.parents.get(call), ast.Await):
+                    not isinstance(module.parents.get(call), ast.Await) \
+                    and self._lockish_receiver(func.value):
                 return (".acquire()",
                         "un-awaited lock acquire blocks the thread "
                         "(asyncio locks are 'await lock.acquire()' / "
                         "'async with lock')")
         return None
+
+    @staticmethod
+    def _lockish_receiver(expr: ast.AST) -> bool:
+        parts = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if isinstance(expr, ast.Name):
+            parts.append(expr.id)
+        if not parts:
+            return True  # unknown receiver shape: keep the old behavior
+        last = parts[0].lower()
+        return any(tok in last for tok in ("lock", "mutex", "sem", "cond"))

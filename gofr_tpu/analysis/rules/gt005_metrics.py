@@ -90,11 +90,22 @@ class MetricDisciplineRule(Rule):
     rule_id = "GT005"
     title = "metric-discipline"
     severity = "error"
+    cross_file = True  # finalize joins registered vs observed repo-wide
 
     def __init__(self, docs_catalog: Optional[pathlib.Path] = None):
         self.docs_catalog = pathlib.Path(docs_catalog or DOCS_CATALOG)
         self._registered: Set[str] = set()
         self._observed: List[Tuple[str, int, str]] = []  # (path, line, name)
+
+    def config_fingerprint(self) -> str:
+        # findings depend on the docs catalog, not just scanned sources
+        try:
+            import hashlib
+            digest = hashlib.sha256(
+                self.docs_catalog.read_bytes()).hexdigest()[:16]
+        except OSError:
+            digest = "missing"
+        return f"{self.rule_id}:{digest}"
 
     def check_module(self, module: ModuleInfo) -> Iterable[Finding]:
         findings: List[Finding] = []

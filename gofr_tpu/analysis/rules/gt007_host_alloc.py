@@ -8,11 +8,13 @@ copies; this rule keeps them dead — a fresh host allocation on a
 dispatch path is exactly the regression the bench's relay block would
 take rounds to re-attribute.
 
-Detection: build the module call graph (callgraph.py), take every
-function reachable from a *dispatch root* — a function whose name is
+Detection (v2, whole-program): take every function reachable from a
+*dispatch root* — a function whose name is
 ``dispatch``/``_dispatch*``/``dispatch_*``, a tick (``_dispatch_tick``
 / ``_dispatch_spec`` / ``tick`` / ``_tick``), admission
-(``_admit_pending``) or the batcher's ``_run`` — and flag:
+(``_admit_pending``) or the batcher's ``_run`` — along the project call
+graph (a staging helper in its own module is still per-dispatch work),
+and flag:
 
 - allocating/copying numpy module calls: ``np.asarray``, ``np.array``,
   ``np.pad``, ``np.stack``, ``np.concatenate``, ``np.copy``,
@@ -33,9 +35,8 @@ naturally exempt. Suppress a justified copy with
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Iterable, List, Optional, Tuple
 
-from gofr_tpu.analysis.callgraph import CallGraph, FunctionNode
 from gofr_tpu.analysis.engine import Finding, ModuleInfo, Rule
 
 # exact dispatch-root function names (matched on the last qualname
@@ -71,21 +72,26 @@ class HostAllocRule(Rule):
     title = "hot-path-host-alloc"
     severity = "error"
 
-    def check_module(self, module: ModuleInfo) -> Iterable[Finding]:
-        graph = CallGraph(module)
-        chains = self._hot_reachable(graph)
+    def check_project(self, project) -> Iterable[Finding]:
+        roots = [ref for ref in project.functions
+                 if _is_hot_root(ref[1])]
+        chains = project.reachable(roots)
         findings: List[Finding] = []
-        for qualname, chain in chains.items():
-            fn = graph.functions[qualname]
-            for node in graph.body_nodes(fn):
+        for ref, chain in chains.items():
+            module = project.module_of(ref)
+            qualname = ref[1]
+            for node in project.body_nodes(ref):
                 if not isinstance(node, ast.Call):
                     continue
                 hit = self._offending(module, node)
                 if hit is None:
                     continue
                 label, why = hit
-                via = (" via " + " -> ".join(chain[1:])
-                       if len(chain) > 1 else "")
+                root = project.display(chain[0], module.relpath)
+                via = (" via " + " -> ".join(
+                    project.display(r, module.relpath)
+                    for r in chain[1:])
+                    if len(chain) > 1 else "")
                 findings.append(Finding(
                     rule=self.rule_id,
                     path=module.relpath,
@@ -93,27 +99,11 @@ class HostAllocRule(Rule):
                     message=(
                         f"hot-path-host-alloc: {label} inside "
                         f"'{qualname}' runs per dispatch (dispatch root "
-                        f"'{chain[0]}'{via}) — {why}"),
+                        f"'{root}'{via}) — {why}"),
                     severity=self.severity,
                     key=f"{label} in {qualname}",
                 ))
         return findings
-
-    # -- reachability from dispatch roots -----------------------------------
-    def _hot_reachable(self, graph: CallGraph) -> Dict[str, List[str]]:
-        chains: Dict[str, List[str]] = {}
-        stack: List[Tuple[str, List[str]]] = [
-            (name, [name]) for name in graph.functions
-            if _is_hot_root(name)]
-        while stack:
-            name, chain = stack.pop()
-            if name in chains:
-                continue
-            chains[name] = chain
-            for callee, _site in graph.functions[name].calls:
-                if callee not in chains:
-                    stack.append((callee, chain + [callee]))
-        return chains
 
     # -- per-call classification --------------------------------------------
     def _offending(self, module: ModuleInfo,

@@ -23,13 +23,18 @@ with a *broad* handler (bare ``except``, ``except Exception``, or
 handler's own body (nested defs excluded) contains at least one of:
 
 - an escape — ``raise``, ``return``, or ``break`` (the failure can
-  leave the loop), or
+  leave the loop), *or a call to a helper whose body unconditionally
+  raises* (an ``_abort(...)``-style escalator, resolved through the
+  project call graph — even when it lives in another module), or
 - pacing — a ``*.sleep(...)`` / ``*.wait(...)`` call (the retry is
   throttled, so a persistent failure degrades to a slow poll instead of
-  a hot spin). Pacing anywhere in the *loop's* own body clears the
-  whole loop: a poll loop that sleeps between iterations cannot spin
-  hot no matter which handler swallows (a ``continue`` can skip a
-  trailing sleep, but that shape is rare enough to accept).
+  a hot spin), *or a call to a helper that itself sleeps/waits* —
+  followed through project call edges up to three hops, so a shared
+  ``backoff()`` utility in its own module clears the loop. Pacing
+  anywhere in the *loop's* own body clears the whole loop: a poll loop
+  that sleeps between iterations cannot spin hot no matter which
+  handler swallows (a ``continue`` can skip a trailing sleep, but that
+  shape is rare enough to accept).
 
 Loops whose test can go false (``while not self._draining``) terminate
 by state and are skipped, as are ``try`` statements *wrapping* the loop
@@ -99,6 +104,70 @@ def _paced(scope: ast.AST) -> bool:
     return False
 
 
+def _always_raises(fn_node: ast.AST) -> bool:
+    """A function whose body cannot fall through: every statement is a
+    docstring/logging ``Expr`` except the final ``Raise``. Calling one
+    from a handler is as good as raising inline."""
+    body = getattr(fn_node, "body", [])
+    if not body or not isinstance(body[-1], ast.Raise):
+        return False
+    return all(isinstance(stmt, ast.Expr) for stmt in body[:-1])
+
+
+class _CallResolver:
+    """Follows project call edges from a scope's call sites: is any
+    callee (transitively, ≤3 hops) paced? does any callee always
+    raise? Works with local-only edges when cross_module is off."""
+
+    def __init__(self, project, ref):
+        self.project = project
+        self._by_site = {}
+        if ref is not None:
+            for callee, site in project.calls(ref):
+                self._by_site.setdefault(id(site), callee)
+
+    def callees_in(self, scope: ast.AST):
+        for node in _own_walk(scope):
+            if isinstance(node, ast.Call):
+                callee = self._by_site.get(id(node))
+                if callee is not None:
+                    yield callee
+
+    def paced_through(self, scope: ast.AST) -> bool:
+        seen = set()
+        stack = list(self.callees_in(scope))
+        depth = {ref: 1 for ref in stack}
+        while stack:
+            ref = stack.pop()
+            if ref in seen:
+                continue
+            seen.add(ref)
+            fn = self.project.functions.get(ref)
+            if fn is None:
+                continue
+            for node in self.project.body_nodes(ref):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else (func.id if isinstance(func, ast.Name) else None)
+                if name in _PACED_CALLS:
+                    return True
+            if depth.get(ref, 1) < 3:
+                for callee, _site in self.project.calls(ref):
+                    if callee not in seen:
+                        depth[callee] = depth.get(ref, 1) + 1
+                        stack.append(callee)
+        return False
+
+    def escapes_through(self, handler: ast.ExceptHandler) -> bool:
+        for ref in self.callees_in(handler):
+            fn = self.project.functions.get(ref)
+            if fn is not None and _always_raises(fn.node):
+                return True
+        return False
+
+
 def _in_handler(module: ModuleInfo, node: ast.AST,
                 loop: ast.While) -> bool:
     """True when ``node`` sits inside an except handler between itself
@@ -120,18 +189,39 @@ def _loop_owner(module: ModuleInfo, loop: ast.While) -> str:
     return "<module>"
 
 
+def _owner_node(module: ModuleInfo, loop: ast.While):
+    node = loop
+    while node in module.parents:
+        node = module.parents[node]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+    return None
+
+
 class UnboundedRetryRule(Rule):
     rule_id = "GT010"
     title = "unbounded-retry"
     severity = "error"
 
-    def check_module(self, module: ModuleInfo) -> Iterable[Finding]:
+    def check_project(self, project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for rel in sorted(project.modules):
+            findings.extend(
+                self._check_module(project.modules[rel], project))
+        return findings
+
+    def _check_module(self, module: ModuleInfo,
+                      project) -> Iterable[Finding]:
         findings: List[Finding] = []
         for loop in ast.walk(module.tree):
             if not isinstance(loop, ast.While) or \
                     not _constant_true(loop.test):
                 continue
-            if _paced(loop):
+            owner_node = _owner_node(module, loop)
+            ref = (project.ref_of_node(owner_node)
+                   if owner_node is not None else None)
+            resolver = _CallResolver(project, ref)
+            if _paced(loop) or resolver.paced_through(loop):
                 continue
             for node in _own_walk(loop):
                 if not isinstance(node, ast.Try):
@@ -141,7 +231,8 @@ class UnboundedRetryRule(Rule):
                 for handler in node.handlers:
                     if not _is_broad(handler):
                         continue
-                    if _escapes(handler):
+                    if _escapes(handler) or \
+                            resolver.escapes_through(handler):
                         continue
                     owner = _loop_owner(module, loop)
                     findings.append(Finding(

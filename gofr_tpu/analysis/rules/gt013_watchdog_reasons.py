@@ -59,6 +59,16 @@ class WatchdogReasonDriftRule(Rule):
     rule_id = "GT013"
     title = "watchdog-signal-drift"
     severity = "error"
+    cross_file = True  # finalize joins documented vs used reasons repo-wide
+
+    def config_fingerprint(self) -> str:
+        try:
+            import hashlib
+            digest = hashlib.sha256(
+                self.docs_catalog.read_bytes()).hexdigest()[:16]
+        except OSError:
+            digest = "missing"
+        return f"{self.rule_id}:{digest}"
 
     def __init__(self, docs_catalog: Optional[pathlib.Path] = None):
         self.docs_catalog = pathlib.Path(docs_catalog or DOCS_CATALOG)

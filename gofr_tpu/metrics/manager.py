@@ -192,6 +192,9 @@ def current_rss_bytes() -> Optional[float]:
     """Current (not peak) resident set size from ``/proc/self/statm``;
     None where procfs is unavailable (macOS, restricted containers)."""
     try:
+        # graftcheck: ignore[GT001] — /proc/self/statm is a procfs read
+        # (kernel memory, microseconds, never blocks on storage); an
+        # executor hop per metrics refresh would cost more than the read
         with open("/proc/self/statm") as fh:
             resident_pages = int(fh.read().split()[1])
         import os

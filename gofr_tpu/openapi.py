@@ -76,6 +76,9 @@ def _load_assets() -> dict:
         for name in _ASSET_TYPES:
             path = os.path.join(_STATIC_DIR, name)
             if os.path.isfile(path):
+                # graftcheck: ignore[GT001] — one-time startup read
+                # (App.start route registration), cached for the process
+                # lifetime; never runs per-request
                 with open(path, "rb") as handle:
                     _asset_cache[name] = handle.read()
         _asset_cache.setdefault("", b"")  # sentinel: scan happened

@@ -245,8 +245,10 @@ class Executor:
         # building device args + queueing the (async) execute — a serve-
         # time compile shows up as a pathological enqueue phase —
         # device_wait = the block_until_ready in fetch
-        # graftcheck: ignore[GT007] — this alloc IS what the staging pool
-        # replaces; kept as the EXEC_STAGING=0 escape hatch
+        # graftcheck: ignore[GT007,GT001] — this alloc IS what the staging
+        # pool replaces; kept as the EXEC_STAGING=0 escape hatch. GT001:
+        # the leaves here are host request arrays (wire-decoded), not
+        # device values, so np.asarray is a cheap host copy, not a D2H sync
         padded = self._tree_unflatten(
             inputs, [_pad_batch(np.asarray(l), bucket) for l in leaves])
         prepped = time.perf_counter()
@@ -267,8 +269,10 @@ class Executor:
         the slab), ``upload`` (device_put) — the bench's relay gap is
         attributable per phase instead of one opaque host number.
         """
-        # graftcheck: ignore[GT007] — serialize phase: converting a
-        # non-ndarray request leaf is the single permitted host copy
+        # graftcheck: ignore[GT007,GT001] — serialize phase: converting a
+        # non-ndarray request leaf is the single permitted host copy.
+        # GT001: request leaves are host-side (lists/wire buffers), so
+        # np.asarray never triggers a device->host sync here
         arrs = [leaf if isinstance(leaf, np.ndarray) else np.asarray(leaf)
                 for leaf in leaves]
         serialized = time.perf_counter()
@@ -316,8 +320,9 @@ class Executor:
                 f"batch {n} exceeds largest bucket {model.buckets[-1]}; "
                 "use predict() which splits oversized batches")
         if self._staging is None:
-            # graftcheck: ignore[GT007] — staging-off fallback keeps the
-            # classic stack path (one extra host copy, same results)
+            # graftcheck: ignore[GT007,GT001] — staging-off fallback keeps
+            # the classic stack path (one extra host copy, same results);
+            # GT001: rows are host request leaves, not device arrays
             batch = self._jax.tree.map(
                 lambda *rows: np.stack([np.asarray(r) for r in rows]),
                 *examples)
@@ -328,8 +333,9 @@ class Executor:
         span = current_span()
         # serialize: non-ndarray leaves → arrays (identity for ndarrays,
         # so wire-decoded numpy rows stay zero-copy here)
-        # graftcheck: ignore[GT007] — per-row conversion is the single
-        # permitted host copy; ndarray leaves pass through untouched
+        # graftcheck: ignore[GT007,GT001] — per-row conversion is the
+        # single permitted host copy; ndarray leaves pass through
+        # untouched. GT001: request rows are host data, never device values
         rows = [[r if isinstance(r, np.ndarray) else np.asarray(r)
                  for r in self._leaves(e)] for e in examples]
         nleaves = len(rows[0])

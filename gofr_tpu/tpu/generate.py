@@ -3513,8 +3513,6 @@ class GenerationEngine:
         if p == 0:
             return 0, bucket, [], []
         nodes = chain[:p]
-        # graftcheck: ignore[GT001] — radix-store refcount pin (host dict
-        # bookkeeping), not a lock acquire; never blocks
         store.acquire(nodes)
         store.record_saved(p * store.page)
         return p, sb, [n.page_id for n in nodes], nodes

@@ -58,12 +58,15 @@ class PagePool:
 
         self._jax = jax
         self._np = np
-        # Serializes donating executions against the pool leaves. One
-        # engine's dispatches are already serialized on its loop, but
-        # co-resident engines (multi-model tenancy) each run cold
-        # dispatches in executor threads: engine A's donation deletes the
-        # handle engine B captured unless call + leaves write-back form
-        # one critical section.
+        # Serializes donating executions against the pool leaves AND the
+        # host-side ownership tables (free list, refcounts). One engine's
+        # dispatches are already serialized on its loop, but co-resident
+        # engines (multi-model tenancy) each run cold dispatches in
+        # executor threads: engine A's donation deletes the handle
+        # engine B captured unless call + leaves write-back form one
+        # critical section. alloc/retain/release/reset take this lock
+        # internally (reentrant), so callers on the engine loop stay
+        # lock-free while staying race-free.
         self.lock = threading.RLock()
         self.cfg = cfg
         self.mesh = mesh
@@ -138,11 +141,13 @@ class PagePool:
         pool is shared by several engines (multi-model tenancy), every
         subscriber is notified so co-resident owners can drop their now
         dangling page ids and device handles."""
-        self._free = list(range(self.num_pages))
-        self._refs = self._np.zeros((self.num_pages,), self._np.int32)
-        self._init_leaves()
-        self._set_gauges()
-        for callback in list(self._reset_subscribers):
+        with self.lock:
+            self._free = list(range(self.num_pages))
+            self._refs = self._np.zeros((self.num_pages,), self._np.int32)
+            self._init_leaves()
+            self._set_gauges()
+            callbacks = list(self._reset_subscribers)
+        for callback in callbacks:
             callback()
 
     def subscribe(self, callback: Callable[[], None]) -> None:
@@ -159,35 +164,45 @@ class PagePool:
         """Allocate ``n`` pages at refcount 1, all-or-nothing. While the
         free list is short, ``reclaim()`` (if given) is called to release
         evictable pages; it returns False when it has nothing left. On
-        failure returns None and counts a stall — never blocks."""
-        while len(self._free) < n and reclaim is not None and reclaim():
-            pass
-        if len(self._free) < n:
-            self.stalls += 1
-            if self.metrics is not None:
-                self.metrics.increment_counter(
-                    "app_tpu_kv_pages_stalled_total")
-            return None
-        ids = [self._free.pop() for _ in range(n)]
-        for pid in ids:
-            self._refs[pid] = 1
-        self.allocs += n
-        self._set_gauges()
-        return ids
+        failure returns None and counts a stall — never blocks.
+
+        Self-serializing: the free list and refcounts mutate under the
+        pool's own (reentrant) lock, so loop-thread allocation cannot
+        race another owner's release — co-resident engines share one
+        pool but not one thread. ``reclaim`` runs under the lock too;
+        eviction callbacks re-enter ``release`` harmlessly (RLock)."""
+        with self.lock:
+            while len(self._free) < n and reclaim is not None \
+                    and reclaim():
+                pass
+            if len(self._free) < n:
+                self.stalls += 1
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_tpu_kv_pages_stalled_total")
+                return None
+            ids = [self._free.pop() for _ in range(n)]
+            for pid in ids:
+                self._refs[pid] = 1
+            self.allocs += n
+            self._set_gauges()
+            return ids
 
     def retain(self, page_ids: Sequence[int]) -> None:
-        for pid in page_ids:
-            self._refs[pid] += 1
+        with self.lock:
+            for pid in page_ids:
+                self._refs[pid] += 1
 
     def release(self, page_ids: Sequence[int]) -> None:
         """Drop one ref per page; refcount 0 returns the page to the free
         list. Releasing an already-free page is a no-op (reset guards)."""
-        for pid in page_ids:
-            if self._refs[pid] > 0:
-                self._refs[pid] -= 1
-                if self._refs[pid] == 0:
-                    self._free.append(pid)
-        self._set_gauges()
+        with self.lock:
+            for pid in page_ids:
+                if self._refs[pid] > 0:
+                    self._refs[pid] -= 1
+                    if self._refs[pid] == 0:
+                        self._free.append(pid)
+            self._set_gauges()
 
     @staticmethod
     def pad_table(table, block: int, sentinel: int):

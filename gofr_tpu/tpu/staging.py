@@ -236,6 +236,10 @@ class TransferCoalescer:
 
         host = {}
         for name, a in arrays.items():
+            # graftcheck: ignore[GT007] — identity (a view, no copy) for
+            # the contiguous arrays the staging path produces; copies only
+            # the rare strided ingest leaf, which the byte-level coalesce
+            # below requires to be contiguous
             a = np.ascontiguousarray(a)
             if a.dtype.byteorder not in "=<|":
                 # jax rejects non-native dtypes outright, and the device-

@@ -7,11 +7,14 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
 # graftcheck static analysis (event-loop hygiene, task discipline,
-# recompile hazards, traced side effects, metric naming + docs-drift)
-# runs before the test sweep so a new finding fails fast with its rule
-# ID and file:line; grandfathered findings live in the committed
-# baseline (scripts/graftcheck_baseline.json)
-env JAX_PLATFORMS=cpu python -m gofr_tpu.analysis || exit 1
+# recompile hazards, traced side effects, metric naming + docs-drift,
+# donation/lock safety) runs before the test sweep so a new finding
+# fails fast with its rule ID and file:line; grandfathered findings
+# live in the committed baseline (scripts/graftcheck_baseline.json).
+# Emits a SARIF artifact for CI annotation plus per-rule wall-clock
+# timings; the incremental cache makes the warm re-run near-free.
+env JAX_PLATFORMS=cpu python -m gofr_tpu.analysis \
+  --sarif /tmp/graftcheck.sarif --timings || exit 1
 # 2-role disaggregated-serving smoke (single process, in-proc transport):
 # prefill export -> kv_wire -> decode adopt, token identity + drain
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/disagg_smoke.py || exit 1

@@ -1,0 +1,15 @@
+"""Import-cycle fixture, half 1: alpha imports beta, beta imports
+alpha. The project graph must index both and resolve edges across the
+cycle without recursing forever."""
+
+from cycle.beta import beta_work
+
+
+async def alpha_root():
+    return beta_work(3)
+
+
+def alpha_helper(n):
+    import time
+    time.sleep(n)   # reached from alpha_root via beta_work (cycle hop)
+    return n

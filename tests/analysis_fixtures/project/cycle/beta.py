@@ -1,0 +1,7 @@
+"""Import-cycle fixture, half 2."""
+
+from cycle.alpha import alpha_helper
+
+
+def beta_work(n):
+    return alpha_helper(n)

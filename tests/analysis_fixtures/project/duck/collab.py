@@ -1,0 +1,10 @@
+"""Duck-typed collaborator: exactly one project class defines
+``settle_rows``, so an unannotated receiver still resolves to it."""
+
+import time
+
+
+class RowSettler:
+    def settle_rows(self, rows):
+        time.sleep(0.01)   # blocks; reachable only via duck typing
+        return rows

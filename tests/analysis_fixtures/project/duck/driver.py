@@ -1,0 +1,9 @@
+"""Calls ``settle_rows`` on an unannotated, untyped parameter — only
+the duck-typed unique-method index can connect this to RowSettler. A
+``get()`` call on the same parameter must NOT resolve (ubiquitous
+container verb, denylisted)."""
+
+
+async def drive(worker, rows):
+    worker.get("x")            # ambiguous verb: no edge, no finding
+    return worker.settle_rows(rows)

@@ -1,0 +1,8 @@
+"""Leaf module: the actual event-loop block lives here."""
+
+import time
+
+
+def settle(rows):
+    time.sleep(0.01)   # the two-modules-away block GT001 must surface
+    return rows

@@ -1,0 +1,16 @@
+"""GT001 cross-module positive: the blocking call sits two modules away
+from the async root — entry (async) -> middle -> blocker. Module-local
+analysis cannot see past the import; project mode must."""
+
+from gt001_xmod.middle import prepare_step
+
+
+async def serve_tick(batch):
+    # looks innocent: just an imported helper call
+    return prepare_step(batch)
+
+
+async def offloaded_tick(loop, batch):
+    # the same helper through an executor hop: never a finding — the
+    # callable is an argument, not a call, so no edge is created
+    return await loop.run_in_executor(None, prepare_step, batch)

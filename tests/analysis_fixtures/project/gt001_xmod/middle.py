@@ -1,0 +1,8 @@
+"""Hop module: no async code, no blocking call of its own."""
+
+from gt001_xmod.blocker import settle
+
+
+def prepare_step(batch):
+    rows = [r for r in batch]
+    return settle(rows)

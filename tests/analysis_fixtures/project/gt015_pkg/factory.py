@@ -1,0 +1,17 @@
+"""GT015 fixture: the donating jit lives behind a factory in its own
+module — the dispatch site never mentions donate_argnums."""
+
+import jax
+
+
+def _step(cache, tokens):
+    return cache + tokens, tokens
+
+
+def make_step():
+    return jax.jit(_step, donate_argnums=(0,))
+
+
+def make_step_via_local():
+    fn = jax.jit(_step, donate_argnums=(0,))
+    return fn

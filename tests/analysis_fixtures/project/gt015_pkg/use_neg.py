@@ -1,0 +1,36 @@
+"""GT015 negatives: the sanctioned donate-then-rebind idiom, plain jit
+without donation, and reads of *other* state after a donating call."""
+
+import jax
+
+from gt015_pkg.factory import make_step
+
+
+def rebind_before_read(cache, tokens):
+    step = make_step()
+    cache, out = step(cache, tokens)   # donated, but rebound in place
+    return cache.sum() + out           # fine: this is the new buffer
+
+
+def no_donation(cache, tokens, fn):
+    plain = jax.jit(fn)                # no donate_argnums: nothing to track
+    out = plain(cache, tokens)
+    return cache.sum() + out
+
+
+class Engine:
+    def __init__(self, fn):
+        self._decode = jax.jit(fn, donate_argnums=(0,))
+        self.leaves = None
+        self.fill = 0
+
+    def rebind_idiom(self, tokens):
+        new_leaves, out = self._decode(self.leaves, tokens)
+        self.leaves = new_leaves       # the write-back makes it safe
+        self.fill += 1                 # reading OTHER attrs is fine
+        return self.leaves, out
+
+    def loop_with_rebind(self, tokens):
+        for tok in tokens:
+            self.leaves, _ = self._decode(self.leaves, tok)
+        return self.leaves

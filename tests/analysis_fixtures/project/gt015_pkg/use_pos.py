@@ -1,0 +1,34 @@
+"""GT015 positives: donated buffers read after dispatch."""
+
+import jax
+
+from gt015_pkg.factory import make_step
+
+
+def stale_read_via_factory(cache, tokens):
+    step = make_step()                # donating fn from another module
+    new_cache, out = step(cache, tokens)
+    return cache.sum() + out         # BAD: cache was donated and deleted
+
+
+class Engine:
+    def __init__(self, fn):
+        self._decode = jax.jit(fn, donate_argnums=(0,))
+        self._fns = {}
+        self._fns[8] = jax.jit(fn, donate_argnums=(0,))
+        self.leaves = None
+
+    def stale_attr_read(self, tokens):
+        new_leaves, out = self._decode(self.leaves, tokens)
+        return self.leaves, out      # BAD: self.leaves donated, not rebound
+
+    def stale_table_read(self, tokens):
+        new_leaves, out = self._fns[8](self.leaves, tokens)
+        return self.leaves, out      # BAD: table dispatch donates too
+
+    def loop_no_rebind(self, tokens):
+        for tok in tokens:
+            _leaves, _ = self._decode(self.leaves, tok)
+            # BAD: self.leaves never rebound inside the loop — the next
+            # iteration donates an already-deleted buffer
+        return self.leaves

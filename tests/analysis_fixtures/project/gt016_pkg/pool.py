@@ -1,0 +1,35 @@
+"""GT016 fixture pools. ``SharedPool`` relies on callers for locking
+(its mutators touch the tables bare); ``SafePool`` is self-serializing
+(every mutation under its own lock), so callers owe nothing."""
+
+import threading
+
+
+class SharedPool:
+    def __init__(self, n):
+        self.lock = threading.RLock()
+        self._free = list(range(n))
+        self._refs = [0] * n
+
+    def alloc(self):
+        pid = self._free.pop()
+        self._refs[pid] = 1
+        return pid
+
+    def release(self, pid):
+        self._refs[pid] -= 1
+        if self._refs[pid] == 0:
+            self._free.append(pid)
+
+    def peek(self):
+        return len(self._free)      # read-only: never a mutator
+
+
+class SafePool:
+    def __init__(self, n):
+        self.lock = threading.RLock()
+        self._free = list(range(n))
+
+    def alloc(self):
+        with self.lock:
+            return self._free.pop()

@@ -1,0 +1,29 @@
+"""GT016 negatives: the lock held lexically, the lock held by every
+caller (worklist coverage), a self-serializing pool, and read-only
+access."""
+
+from gt016_pkg.pool import SafePool, SharedPool
+
+
+class LockedAdmitter:
+    def __init__(self, pool: SharedPool, safe: SafePool):
+        self.pool = pool
+        self.safe = safe
+
+    def admit(self):
+        with self.pool.lock:
+            return self.pool.alloc()         # locked: fine
+
+    def admit_via_helper(self):
+        with self.pool.lock:
+            return self._locked_alloc()      # lock held by the caller
+
+    def _locked_alloc(self):
+        # only ever entered from under the lock above — caller-covered
+        return self.pool.alloc()
+
+    def admit_safe(self):
+        return self.safe.alloc()             # self-serializing pool: fine
+
+    def occupancy(self):
+        return self.pool.peek()              # read-only: never flagged

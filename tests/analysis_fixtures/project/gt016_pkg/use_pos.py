@@ -1,0 +1,14 @@
+"""GT016 positives: free-list mutation reachable with no lock held."""
+
+from gt016_pkg.pool import SharedPool
+
+
+class Admitter:
+    def __init__(self, pool: SharedPool):
+        self.pool = pool
+
+    def admit(self):
+        return self.pool.alloc()     # BAD: bare mutator call, no lock
+
+    def evict(self, pid):
+        self.pool.release(pid)       # BAD: same, via a second mutator

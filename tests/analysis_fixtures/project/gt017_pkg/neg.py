@@ -1,0 +1,37 @@
+"""GT017 negatives: lock released before awaiting, asyncio lock via
+``async with``, snapshot iteration, and collect-then-mutate."""
+
+
+class Engine:
+    def __init__(self, pool, slots, alock):
+        self._pool = pool
+        self._slots = slots
+        self._alock = alock
+
+    async def fetch_unlocked(self, batch):
+        with self._pool.lock:
+            staged = self._stage(batch)        # lock released before await
+        return await self._dispatch(staged)
+
+    async def fetch_async_lock(self, batch):
+        async with self._alock:                # asyncio lock: designed
+            return await self._dispatch(batch)  # for cross-await holds
+
+    async def drain_snapshot(self):
+        for sid, slot in list(self._slots.items()):   # snapshot: safe
+            await slot.drain()
+            del self._slots[sid]
+
+    async def drain_collect(self):
+        doomed = []
+        for sid, slot in self._slots.items():
+            await slot.drain()
+            doomed.append(sid)                 # mutate AFTER the loop
+        for sid in doomed:
+            del self._slots[sid]
+
+    def _stage(self, batch):
+        return batch
+
+    async def _dispatch(self, batch):
+        return batch

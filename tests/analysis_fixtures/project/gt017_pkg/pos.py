@@ -1,0 +1,29 @@
+"""GT017 positives: a thread lock held across await, and a slot table
+mutated while being iterated with an await in between."""
+
+
+class Engine:
+    def __init__(self, pool, slots):
+        self._pool = pool
+        self._slots = slots
+
+    async def fetch_locked(self, batch):
+        with self._pool.lock:                  # BAD: sync lock ...
+            out = await self._dispatch(batch)  # ... held across await
+        return out
+
+    async def drain_all(self):
+        for sid, slot in self._slots.items():
+            await slot.drain()
+            del self._slots[sid]               # BAD: mutates mid-iteration
+
+    async def evict_some(self):
+        for sid in self._slots:
+            await self._probe(sid)
+            self._slots.pop(sid)               # BAD: pop during iteration
+
+    async def _dispatch(self, batch):
+        return batch
+
+    async def _probe(self, sid):
+        return sid

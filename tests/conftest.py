@@ -33,3 +33,24 @@ def mock_container():
 def cpu_mesh():
     """2×4 dp×tp mesh over the 8 virtual CPU devices."""
     return jax.make_mesh((2, 4), ("dp", "tp"))
+
+
+@pytest.fixture(scope="session")
+def graftcheck_repo_scan(tmp_path_factory):
+    """One cold full-repo graftcheck scan, shared by every test that
+    needs a no-baseline repo report or a warm cache — the scan is the
+    single most expensive fixture in the suite, so pay it exactly once.
+    Returns ``(cache_path, cold_report, cold_seconds)``; the cache file
+    is a throwaway so the repo's own ``.graftcheck_cache.json`` (and the
+    committed baseline) stay untouched."""
+    import time as _time
+
+    from gofr_tpu.analysis import engine
+    from gofr_tpu.analysis.rules import default_rules
+
+    cache = tmp_path_factory.mktemp("graftcheck") / "cache.json"
+    t0 = _time.perf_counter()
+    cold = engine.run(paths=[engine.PACKAGE], rules=default_rules(),
+                      baseline={}, cache_path=cache)
+    cold_secs = _time.perf_counter() - t0
+    return cache, cold, cold_secs

@@ -354,11 +354,12 @@ def test_unparseable_file_fails(tmp_path):
 
 # -- self-clean + CLI contract -----------------------------------------------
 
-def test_repo_scans_clean_without_baseline():
+def test_repo_scans_clean_without_baseline(graftcheck_repo_scan):
     """The shipped tree has zero unsuppressed findings — the committed
-    baseline stays empty, so any new finding fails tier1 immediately."""
-    report = engine.run(paths=[engine.PACKAGE], rules=default_rules(),
-                        baseline={})
+    baseline stays empty, so any new finding fails tier1 immediately.
+    Reuses the session-scoped cold scan (conftest.py) instead of paying
+    a second full-repo pass."""
+    _, report, _ = graftcheck_repo_scan
     assert [f.render() for f in report.new_findings] == []
     assert report.parse_errors == []
 
@@ -367,9 +368,10 @@ def test_committed_baseline_is_empty():
     assert engine.load_baseline(engine.DEFAULT_BASELINE) == {}
 
 
-def test_cli_exits_zero_on_repo():
+def test_cli_exits_zero_on_repo(graftcheck_repo_scan):
+    cache, _, _ = graftcheck_repo_scan   # warm: skip the cold re-scan
     proc = subprocess.run(
-        [sys.executable, "-m", "gofr_tpu.analysis"],
+        [sys.executable, "-m", "gofr_tpu.analysis", "--cache", str(cache)],
         cwd=REPO, capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "graftcheck: OK" in proc.stdout
@@ -400,7 +402,8 @@ def test_cli_list_rules_covers_catalog():
         assert cls.rule_id in proc.stdout
     assert {cls.rule_id for cls in ALL_RULES} == \
         {"GT001", "GT002", "GT003", "GT004", "GT005", "GT006", "GT007",
-         "GT008", "GT009", "GT010", "GT011", "GT012", "GT013", "GT014"}
+         "GT008", "GT009", "GT010", "GT011", "GT012", "GT013", "GT014",
+         "GT015", "GT016", "GT017"}
 
 
 def test_lint_metrics_shim_still_works():
